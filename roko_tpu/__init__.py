@@ -1,0 +1,31 @@
+"""roko_tpu — a TPU-native deep-learning consensus polisher framework.
+
+A from-scratch reimplementation of the capabilities of lbcb-sci/roko
+(reference layout documented in SURVEY.md), designed TPU-first:
+
+- host side: self-contained BAM/BGZF I/O (no htslib dependency), a C++
+  feature extractor for the pileup hot path, multiprocess region fan-out,
+  HDF5 interchange;
+- device side: pure JAX/Flax models (bidirectional GRU with a Pallas
+  recurrent kernel, transformer-encoder variant), `jit`-compiled train and
+  inference steps sharded over a `jax.sharding.Mesh` (dp/tp/sp axes) with
+  XLA collectives over ICI.
+
+Pipeline (mirrors the reference's three CLI stages, ref: README.md:7):
+
+    roko-tpu features  FASTA + BAM [+ truth BAM]  ->  features.hdf5
+    roko-tpu train     features.hdf5 dir          ->  orbax checkpoints
+    roko-tpu infer     features.hdf5 + checkpoint ->  polished.fasta
+"""
+
+__version__ = "0.1.0"
+
+from roko_tpu import constants  # noqa: F401
+from roko_tpu.config import (  # noqa: F401
+    ModelConfig,
+    ReadFilterConfig,
+    RegionConfig,
+    RokoConfig,
+    TrainConfig,
+    WindowConfig,
+)

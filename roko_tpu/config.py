@@ -1,0 +1,133 @@
+"""Typed configuration for the whole framework.
+
+One config object replaces the reference's scattered constants and argparse
+defaults (ref: include/generate.h:19-23, roko/features.py:16,
+roko/rnn_model.py:10-12, roko/train.py:12-15, include/models.h:22-23).
+All configs are frozen dataclasses serialisable to/from plain dicts so they
+can ride along in checkpoints and HDF5 attrs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from roko_tpu import constants as C
+
+
+def _asdict(cfg: Any) -> Dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+@dataclass(frozen=True)
+class WindowConfig:
+    """Pileup window geometry (ref: include/generate.h:19-23)."""
+
+    rows: int = C.WINDOW_ROWS
+    cols: int = C.WINDOW_COLS
+    stride: int = C.WINDOW_STRIDE
+    max_ins: int = C.MAX_INS
+    ref_rows: int = C.REF_ROWS
+
+
+@dataclass(frozen=True)
+class ReadFilterConfig:
+    """Pileup read filter policy (ref: include/models.h:22-23, models.cpp:25-27)."""
+
+    min_mapq: int = C.MIN_MAPQ
+    filter_flag: int = C.FILTER_FLAG
+    #: paired reads must additionally be proper pairs
+    require_proper_pair: bool = True
+
+
+@dataclass(frozen=True)
+class RegionConfig:
+    """Contig -> region fan-out (ref: roko/features.py:16-27)."""
+
+    size: int = C.REGION_SIZE
+    overlap: int = C.REGION_OVERLAP
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model family + dimensions (ref: roko/rnn_model.py:10-12,24-44)."""
+
+    kind: str = "gru"  # "gru" | "transformer"
+    embed_vocab: int = C.FEATURE_VOCAB
+    embed_dim: int = 50
+    read_mlp: Tuple[int, ...] = (100, 10)
+    hidden_size: int = 128
+    num_layers: int = 3
+    dropout: float = 0.2
+    num_classes: int = C.NUM_CLASSES
+    # transformer variant only
+    d_model: int = 256
+    num_heads: int = 8
+    mlp_ratio: int = 4
+    # compute dtype for matmuls ("bfloat16" rides the MXU; params stay f32)
+    compute_dtype: str = "float32"
+    # use the Pallas fused GRU kernel when running on TPU
+    use_pallas: bool = False
+
+    @property
+    def gru_in_size(self) -> int:
+        return self.embed_dim * self.read_mlp[-1]
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Optimisation hyperparameters (ref: roko/train.py:12-15)."""
+
+    batch_size: int = 128
+    epochs: int = 100
+    lr: float = 1e-4
+    patience: int = 7
+    seed: int = 0
+    #: keep the whole dataset resident in host RAM (ref: --memory flag)
+    in_memory: bool = True
+    #: checkpoint directory keeps this many best checkpoints
+    keep_checkpoints: int = 3
+    #: number of host prefetch batches queued ahead of the device
+    prefetch: int = 2
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device mesh axes. dp shards the batch; tp shards the model
+    (transformer variant); sp shards the sequence axis (ring attention)."""
+
+    dp: int = -1  # -1 = all remaining devices
+    tp: int = 1
+    sp: int = 1
+
+
+@dataclass(frozen=True)
+class RokoConfig:
+    window: WindowConfig = field(default_factory=WindowConfig)
+    read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
+    region: RegionConfig = field(default_factory=RegionConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+    def to_json(self) -> str:
+        return json.dumps(_asdict(self), indent=2, sort_keys=True)
+
+    @staticmethod
+    def from_json(text: str) -> "RokoConfig":
+        raw = json.loads(text)
+        return RokoConfig(
+            window=WindowConfig(**raw.get("window", {})),
+            read_filter=ReadFilterConfig(**raw.get("read_filter", {})),
+            region=RegionConfig(**raw.get("region", {})),
+            model=ModelConfig(**{k: tuple(v) if k == "read_mlp" else v
+                                 for k, v in raw.get("model", {}).items()}),
+            train=TrainConfig(**raw.get("train", {})),
+            mesh=MeshConfig(**raw.get("mesh", {})),
+        )
+
+
+def default_config(model_kind: str = "gru", **model_overrides: Any) -> RokoConfig:
+    return RokoConfig(model=ModelConfig(kind=model_kind, **model_overrides))

@@ -1,0 +1,105 @@
+"""Shared encoding tables and geometry constants.
+
+This is the single source of truth for the alphabet, strand encoding and
+window geometry. The reference duplicated its alphabet in two modules
+(ref: roko/labels.py:6-9 vs roko/inference.py:14-17) and pinned the window
+geometry in a C++ header (ref: include/generate.h:19-23); here both the
+Python pipeline and the C++ extractor (roko_tpu/native) consume these
+values — the native library's compiled constants are asserted against this
+module at load time.
+"""
+
+from __future__ import annotations
+
+# ---------------------------------------------------------------------------
+# Alphabet / label encoding (ref: roko/labels.py:6-10)
+# ---------------------------------------------------------------------------
+GAP = "*"
+UNKNOWN = "N"
+ALPHABET = "ACGT" + GAP + UNKNOWN  # index == encoded value
+
+ENCODING = {base: i for i, base in enumerate(ALPHABET)}
+DECODING = {i: base for i, base in enumerate(ALPHABET)}
+
+ENCODED_GAP = ENCODING[GAP]  # 4
+ENCODED_UNKNOWN = ENCODING[UNKNOWN]  # 5
+
+#: Classes predicted by the model: A, C, G, T, GAP. UNKNOWN is never a
+#: target — windows containing UNKNOWN labels are rejected at feature time
+#: (ref: roko/features.py:72-75).
+NUM_CLASSES = 5
+
+#: Feature values 0-5 encode a forward-strand base; reverse strand adds
+#: this offset (ref: generate.cpp:17-25, 126-146).
+STRAND_OFFSET = 6
+FEATURE_VOCAB = 2 * len(ALPHABET)  # 12
+
+# ---------------------------------------------------------------------------
+# Window geometry (ref: include/generate.h:19-23)
+# ---------------------------------------------------------------------------
+#: Rows per feature window: reads sampled with replacement.
+WINDOW_ROWS = 200
+#: Columns per feature window: (position, insertion-slot) pairs.
+WINDOW_COLS = 90
+#: Windows slide by this many columns (= 60-column overlap, so every
+#: position is covered by at most 3 windows).
+WINDOW_STRIDE = WINDOW_COLS // 3  # 30
+#: Maximum insertion slots tracked after each reference position.
+MAX_INS = 3
+#: Rows reserved for the draft sequence itself. The reference compiles
+#: this to 0 (ref: include/generate.h:23) — kept for schema parity.
+REF_ROWS = 0
+
+# ---------------------------------------------------------------------------
+# Region fan-out (ref: roko/features.py:16)
+# ---------------------------------------------------------------------------
+REGION_SIZE = 100_000
+REGION_OVERLAP = 300
+
+# ---------------------------------------------------------------------------
+# Read filter policy (ref: include/models.h:22-23, models.cpp:25-27)
+# ---------------------------------------------------------------------------
+MIN_MAPQ = 10
+
+# BAM flag bits (SAM spec §1.4).
+FLAG_PAIRED = 0x1
+FLAG_PROPER_PAIR = 0x2
+FLAG_UNMAP = 0x4
+FLAG_MUNMAP = 0x8
+FLAG_REVERSE = 0x10
+FLAG_MREVERSE = 0x20
+FLAG_READ1 = 0x40
+FLAG_READ2 = 0x80
+FLAG_SECONDARY = 0x100
+FLAG_QCFAIL = 0x200
+FLAG_DUP = 0x400
+FLAG_SUPPLEMENTARY = 0x800
+
+#: Reads with any of these flags are excluded from the pileup.
+FILTER_FLAG = (
+    FLAG_UNMAP | FLAG_DUP | FLAG_QCFAIL | FLAG_SUPPLEMENTARY | FLAG_SECONDARY
+)
+
+# ---------------------------------------------------------------------------
+# Base <-> feature-code helpers
+# ---------------------------------------------------------------------------
+#: 4-bit BAM seq nibble -> encoded base (ref: include/models.h:120-138).
+#: A=1, C=2, G=4, T=8, N=15; any other nibble is an error in the reference.
+NIBBLE_TO_CODE = {1: 0, 2: 1, 4: 2, 8: 3, 15: ENCODED_UNKNOWN}
+
+#: char -> encoded base for draft sequences (ref: include/models.h:153-173).
+CHAR_TO_CODE = {
+    "A": 0, "a": 0,
+    "C": 1, "c": 1,
+    "G": 2, "g": 2,
+    "T": 3, "t": 3,
+    "N": ENCODED_UNKNOWN, "-": ENCODED_UNKNOWN,
+    "*": ENCODED_GAP,
+}
+
+# CIGAR operation codes (SAM spec §1.4.6): MIDNSHP=X
+CIGAR_M, CIGAR_I, CIGAR_D, CIGAR_N, CIGAR_S, CIGAR_H, CIGAR_P, CIGAR_EQ, CIGAR_X = range(9)
+CIGAR_OPS = "MIDNSHP=X"
+#: ops that consume the query sequence / the reference sequence
+CIGAR_CONSUMES_QUERY = (True, True, False, False, True, False, False, True, True)
+CIGAR_CONSUMES_REF = (True, False, True, True, False, False, False, True, True)

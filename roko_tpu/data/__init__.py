@@ -1,0 +1,6 @@
+from roko_tpu.data.hdf5 import (  # noqa: F401
+    DataWriter,
+    iter_inference_windows,
+    load_contigs,
+    load_training_arrays,
+)

@@ -1,0 +1,173 @@
+"""HDF5 interchange between the host feature extractor and the device
+train/inference stages.
+
+Schema (contract documented in SURVEY.md §2.8, ref: roko/data.py:38-48,
+84-91):
+
+- root groups named ``{contig}_{start}-{end}`` with datasets
+  ``positions`` int64[N,90,2], ``examples`` uint8[N,200,90] (chunked
+  (1,200,90)) and, for training data, ``labels`` int64[N,90]; attrs
+  ``contig`` and ``size``;
+- a ``contigs/{name}`` group per draft contig with attrs ``name``,
+  ``seq`` (the full draft string) and ``len``.
+
+Group names get a ``.{k}`` suffix on collision (the reference would raise
+on a repeated span; flush batching makes that reachable). Files use
+``libver="latest"``; readers should open files only after the writer
+finishes (the reference's ``swmr=True`` on a write-mode open was a no-op).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import h5py
+import numpy as np
+
+
+class _ContigBuffer:
+    def __init__(self, name: str, infer: bool):
+        self.name = name
+        self.infer = infer
+        self.pos: List[np.ndarray] = []
+        self.X: List[np.ndarray] = []
+        self.Y: List[np.ndarray] = []
+
+    def extend(self, pos, X, Y) -> None:
+        if self.infer:
+            assert len(pos) == len(X)
+        else:
+            assert Y is not None and len(pos) == len(X) == len(Y)
+        self.pos.extend(np.asarray(p, dtype=np.int64) for p in pos)
+        self.X.extend(np.asarray(x, dtype=np.uint8) for x in X)
+        if not self.infer:
+            self.Y.extend(np.asarray(y, dtype=np.int64) for y in Y)
+
+    def write(self, fd: h5py.File) -> None:
+        if not self.pos:
+            return
+        start = int(self.pos[0][0][0])
+        end = int(self.pos[-1][-1][0])
+        base = f"{self.name}_{start}-{end}"
+        group_name, k = base, 0
+        while group_name in fd:
+            k += 1
+            group_name = f"{base}.{k}"
+
+        group = fd.create_group(group_name)
+        group["positions"] = np.stack(self.pos)
+        if not self.infer:
+            group["labels"] = np.stack(self.Y)
+        group.attrs["contig"] = self.name
+        group.attrs["size"] = len(self.pos)
+        X = np.stack(self.X)
+        group.create_dataset("examples", data=X, chunks=(1,) + X.shape[1:])
+
+        self.pos.clear()
+        self.X.clear()
+        self.Y.clear()
+
+
+class DataWriter:
+    """Buffers windows per contig; ``write()`` flushes buffers to disk
+    (ref: roko/data.py:57-91)."""
+
+    def __init__(self, filename: str, infer: bool):
+        self.filename = filename
+        self.infer = infer
+        self._buffers: Dict[str, _ContigBuffer] = {}
+        self._fd: Optional[h5py.File] = None
+
+    def __enter__(self) -> "DataWriter":
+        self._fd = h5py.File(self.filename, "w", libver="latest")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.write()
+        self._fd.close()
+        self._fd = None
+
+    def write_contigs(self, refs: Sequence[Tuple[str, str]]) -> None:
+        group = self._fd.create_group("contigs")
+        for name, seq in refs:
+            contig = group.create_group(name)
+            contig.attrs["name"] = name
+            contig.attrs["seq"] = seq
+            contig.attrs["len"] = len(seq)
+
+    def store(self, contig: str, positions, examples, labels) -> None:
+        buf = self._buffers.get(contig)
+        if buf is None:
+            buf = self._buffers[contig] = _ContigBuffer(contig, self.infer)
+        buf.extend(positions, examples, labels)
+
+    def write(self) -> None:
+        for buf in self._buffers.values():
+            buf.write(self._fd)
+
+
+# ---------------------------------------------------------------------------
+# Readers
+# ---------------------------------------------------------------------------
+def data_group_names(fd: h5py.File) -> List[str]:
+    return [g for g in fd.keys() if g not in ("contigs", "info")]
+
+
+def hdf5_files(path: str) -> List[str]:
+    """A single file, or every ``*.hdf5`` in a directory
+    (ref: roko/datasets.py:9-17)."""
+    if os.path.isdir(path):
+        return sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if f.endswith(".hdf5") or f.endswith(".h5")
+        )
+    return [path]
+
+
+def load_training_arrays(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """Concatenate all examples/labels across files into host RAM
+    (ref: InMemoryTrainDataset, roko/datasets.py:82-119)."""
+    xs, ys = [], []
+    for filename in hdf5_files(path):
+        with h5py.File(filename, "r") as fd:
+            for g in data_group_names(fd):
+                xs.append(fd[g]["examples"][()])
+                ys.append(fd[g]["labels"][()])
+    if not xs:
+        raise ValueError(f"no training groups found under {path}")
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def load_contigs(path: str) -> Dict[str, str]:
+    with h5py.File(path, "r") as fd:
+        out = {}
+        for name in fd["contigs"]:
+            out[str(name)] = fd["contigs"][name].attrs["seq"]
+        return out
+
+
+def iter_inference_windows(
+    path: str, batch_size: int
+) -> Iterator[Tuple[List[str], np.ndarray, np.ndarray]]:
+    """Yield ``(contigs, positions[B,90,2], examples[B,200,90])`` batches
+    in deterministic group order. The final batch may be short."""
+    with h5py.File(path, "r") as fd:
+        buf_c: List[str] = []
+        buf_p: List[np.ndarray] = []
+        buf_x: List[np.ndarray] = []
+        for g in sorted(data_group_names(fd)):
+            contig = fd[g].attrs["contig"]
+            positions = fd[g]["positions"][()]
+            examples = fd[g]["examples"][()]
+            n = positions.shape[0]
+            for i in range(n):
+                buf_c.append(contig)
+                buf_p.append(positions[i])
+                buf_x.append(examples[i])
+                if len(buf_c) == batch_size:
+                    yield buf_c, np.stack(buf_p), np.stack(buf_x)
+                    buf_c, buf_p, buf_x = [], [], []
+        if buf_c:
+            yield buf_c, np.stack(buf_p), np.stack(buf_x)

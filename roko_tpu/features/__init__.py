@@ -1,0 +1,2 @@
+from roko_tpu.features.extract import Window, extract_windows  # noqa: F401
+from roko_tpu.features.pileup import PileupEntry, pileup_columns  # noqa: F401

@@ -1,0 +1,46 @@
+"""Extractor backend selection: C++ (roko_tpu.native) when built, else the
+pure-Python reference implementation. Both are seed-for-seed identical;
+``tests/test_native.py`` asserts bit equality."""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+from roko_tpu.config import ReadFilterConfig, WindowConfig
+from roko_tpu.features.extract import Window, extract_windows
+from roko_tpu.io.bam import BamReader
+
+_FORCE_PY = os.environ.get("ROKO_TPU_FORCE_PY_EXTRACTOR", "") == "1"
+
+
+def _native_available() -> bool:
+    if _FORCE_PY:
+        return False
+    try:
+        from roko_tpu.native import binding  # noqa: F401
+
+        return binding.is_available()
+    except Exception:
+        return False
+
+
+def extract_region_windows(
+    bam_path: str,
+    contig: str,
+    start: int,
+    end: int,
+    seed: int,
+    window_cfg: WindowConfig,
+    filter_cfg: ReadFilterConfig,
+) -> List[Window]:
+    if _native_available():
+        from roko_tpu.native import binding
+
+        return binding.extract_windows(
+            bam_path, contig, start, end, seed, window_cfg, filter_cfg
+        )
+    with BamReader(bam_path) as reader:
+        return list(
+            extract_windows(reader, contig, start, end, seed, window_cfg, filter_cfg)
+        )

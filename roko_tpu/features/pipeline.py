@@ -1,0 +1,211 @@
+"""Region fan-out and feature/label generation driver.
+
+Mirrors the reference orchestration (ref: roko/features.py): contigs are
+split into 100 kb regions with 300 bp overlap; each region is processed by
+a worker (multiprocessing Pool) producing windows (and labels in training
+mode); results are buffered per contig and flushed to HDF5 every 10
+finished regions.
+
+Workers pick the fastest available extractor backend (C++ via
+``roko_tpu.native`` when built, else the Python reference implementation)
+— both produce bit-identical windows for a given seed.
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from roko_tpu import constants as C
+from roko_tpu.config import RegionConfig, RokoConfig
+from roko_tpu.data.hdf5 import DataWriter
+from roko_tpu.features import labels as L
+from roko_tpu.features.backend import extract_region_windows
+from roko_tpu.features.labels import Region
+from roko_tpu.io.bam import BamReader
+from roko_tpu.io.fasta import read_fasta
+from roko_tpu.utils.rng import derive_region_seed
+
+
+def generate_regions(
+    ref_len: int, name: str, cfg: Optional[RegionConfig] = None
+) -> Iterator[Region]:
+    """100 kb regions with 300 bp overlap (ref: roko/features.py:16-27)."""
+    cfg = cfg or RegionConfig()
+    i = 0
+    while i < ref_len:
+        end = i + cfg.size
+        yield Region(name, i, min(end, ref_len))
+        if end >= ref_len:
+            break
+        i = end - cfg.overlap
+
+
+@dataclass
+class _Job:
+    bam_x: str
+    bam_y: Optional[str]
+    region: Region
+    seed: int
+    config: RokoConfig
+
+
+def _is_in_region(pos: int, aligns: Sequence[L.TargetAlign]) -> bool:
+    return any(a.start <= pos < a.end for a in aligns)
+
+
+def generate_infer(job: _Job):
+    """Feature windows for one region, inference mode
+    (ref: roko/features.py:97-110)."""
+    region = job.region
+    windows = extract_region_windows(
+        job.bam_x,
+        region.name,
+        region.start,
+        region.end,
+        job.seed,
+        job.config.window,
+        job.config.read_filter,
+    )
+    positions = [w.positions for w in windows]
+    examples = [w.matrix for w in windows]
+    return region.name, positions, examples, None
+
+
+def generate_train(job: _Job):
+    """Feature windows + labels for one region, training mode
+    (ref: roko/features.py:37-94)."""
+    region = job.region
+    with BamReader(job.bam_y) as truth:
+        alignments = L.get_aligns(
+            truth, ref_name=region.name, start=region.start, end=region.end
+        )
+    filtered = L.filter_aligns(alignments)
+    if not filtered:
+        return None
+
+    positions, examples, labels = [], [], []
+
+    for a in filtered:
+        pos_labels = {}
+        n_pos = set()
+
+        t_pos, t_labels = L.get_pos_and_labels(a, region)
+        for p, lab in zip(t_pos, t_labels):
+            if lab == C.ENCODED_UNKNOWN:
+                n_pos.add(p)
+            else:
+                pos_labels[p] = lab
+        if not pos_labels:
+            continue
+
+        pos_sorted = sorted(pos_labels)
+        # labeled span, end-exclusive: the last labeled position is
+        # excluded, matching the reference's 1-based region string
+        # `start+1`-`last` (ref: roko/features.py:62-63)
+        span_start, span_end = pos_sorted[0][0], pos_sorted[-1][0]
+        if span_end <= span_start:
+            continue
+
+        windows = extract_region_windows(
+            job.bam_x,
+            region.name,
+            span_start,
+            span_end,
+            job.seed,
+            job.config.window,
+            job.config.read_filter,
+        )
+
+        for w in windows:
+            Y = []
+            keep = True
+            for p in map(tuple, w.positions):
+                if not _is_in_region(p[0], filtered):
+                    raise AssertionError(
+                        f"window position {p} outside filtered truth alignments"
+                    )
+                if p in n_pos:
+                    keep = False
+                    break
+                try:
+                    y = pos_labels[p]
+                except KeyError:
+                    if p[1] != 0:
+                        # unlabeled insertion slot: the truth has no base
+                        # there -> GAP (ref: roko/features.py:81-84)
+                        y = C.ENCODED_GAP
+                    else:
+                        raise KeyError(f"no label mapping for position {p}")
+                Y.append(y)
+
+            if keep:
+                positions.append(w.positions)
+                examples.append(w.matrix)
+                labels.append(np.asarray(Y, dtype=np.int64))
+
+    return region.name, positions, examples, labels
+
+
+def run_features(
+    ref_path: str,
+    bam_x: str,
+    out_path: str,
+    bam_y: Optional[str] = None,
+    workers: int = 1,
+    seed: int = 0,
+    config: Optional[RokoConfig] = None,
+    flush_every: int = 10,
+) -> int:
+    """Generate a features HDF5. Returns the number of windows written."""
+    config = config or RokoConfig()
+    inference = bam_y is None
+    refs = read_fasta(ref_path)
+
+    jobs: List[_Job] = []
+    for name, seq in refs:
+        for region in generate_regions(len(seq), name, config.region):
+            jobs.append(
+                _Job(
+                    bam_x=bam_x,
+                    bam_y=bam_y,
+                    region=region,
+                    seed=derive_region_seed(seed, name, region.start),
+                    config=config,
+                )
+            )
+
+    func = generate_infer if inference else generate_train
+    total = 0
+
+    with DataWriter(out_path, inference) as data:
+        data.write_contigs(refs)
+
+        if workers <= 1:
+            results = map(func, jobs)
+            pool = None
+        else:
+            pool = multiprocessing.Pool(processes=workers)
+            results = pool.imap(func, jobs)
+
+        try:
+            finished = 0
+            for result in results:
+                if not result:
+                    continue
+                contig, p, x, y = result
+                data.store(contig, p, x, y)
+                total += len(p)
+                finished += 1
+                if finished % flush_every == 0:
+                    data.write()
+            data.write()
+        finally:
+            if pool is not None:
+                pool.close()
+                pool.join()
+
+    return total

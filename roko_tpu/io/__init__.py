@@ -1,0 +1,9 @@
+"""Host-side I/O: FASTA, BGZF, BAM(+BAI), HDF5 interchange.
+
+Self-contained — no htslib/pysam/biopython dependency. The C++ extractor in
+``roko_tpu/native`` implements the same BAM/BGZF formats for the hot path;
+this package is the readable reference implementation and the test oracle.
+"""
+
+from roko_tpu.io.fasta import read_fasta, write_fasta  # noqa: F401
+from roko_tpu.io.bam import BamReader, BamRecord, BamWriter  # noqa: F401

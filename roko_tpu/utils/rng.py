@@ -1,0 +1,41 @@
+"""SplitMix64 — the deterministic sampling RNG shared by the Python and C++
+feature extractors.
+
+The reference seeds libc ``rand`` from wall-clock per extractor call
+(ref: gen.cpp:11), making feature matrices nondeterministic run-to-run.
+Here every region derives a stable seed from (user seed, contig, region
+start) and both extractor implementations use this exact generator, so
+golden tests can assert bit-identical windows across languages.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+_MASK = (1 << 64) - 1
+
+
+class SplitMix64:
+    """Sebastiano Vigna's SplitMix64 (public domain reference algorithm)."""
+
+    def __init__(self, seed: int):
+        self.state = seed & _MASK
+
+    def next_u64(self) -> int:
+        self.state = (self.state + 0x9E3779B97F4A7C15) & _MASK
+        z = self.state
+        z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+        z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _MASK
+        return (z ^ (z >> 31)) & _MASK
+
+    def next_below(self, n: int) -> int:
+        """Uniform-ish draw in [0, n) by modulo (bias < 2**-50 for the
+        n <= a-few-thousand draws used here; determinism matters more)."""
+        return self.next_u64() % n
+
+
+def derive_region_seed(base_seed: int, contig: str, start: int) -> int:
+    """Stable per-region seed so results are independent of worker
+    scheduling. crc32 keeps it trivially portable to the C++ side."""
+    h = zlib.crc32(contig.encode())
+    return (base_seed * 0x100000001B3 + (h << 32 | (start & 0xFFFFFFFF))) & _MASK

@@ -1,0 +1,145 @@
+"""Shared test fixtures: tiny synthetic genomes, read simulation, and BAM
+fixture construction (the reference ships no tests — SURVEY.md §4 defines
+this strategy: synthetic FASTA+BAM fixtures driving the extractor)."""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional, Sequence, Tuple
+
+from roko_tpu import constants as C
+from roko_tpu.io.bam import BamRecord
+
+BASES = "ACGT"
+
+
+def random_seq(rng: random.Random, n: int) -> str:
+    return "".join(rng.choice(BASES) for _ in range(n))
+
+
+def mutate(
+    rng: random.Random,
+    seq: str,
+    sub_rate: float = 0.0,
+    ins_rate: float = 0.0,
+    del_rate: float = 0.0,
+    max_indel: int = 3,
+) -> str:
+    """Apply random substitutions/insertions/deletions — used to derive a
+    'draft' from a 'truth' genome or noisy reads from a template."""
+    out = []
+    i = 0
+    while i < len(seq):
+        r = rng.random()
+        if r < del_rate:
+            i += rng.randint(1, max_indel)
+            continue
+        b = seq[i]
+        if r < del_rate + sub_rate:
+            b = rng.choice([x for x in BASES if x != seq[i]])
+        out.append(b)
+        if rng.random() < ins_rate:
+            out.append(random_seq(rng, rng.randint(1, max_indel)))
+        i += 1
+    return "".join(out)
+
+
+def align_to_ref(query: str, ref: str, ref_start: int) -> Tuple[int, Tuple[Tuple[int, int], ...]]:
+    """Trivial gapless alignment helper: full-length M at ref_start."""
+    return ref_start, ((C.CIGAR_M, len(query)),)
+
+
+def make_record(
+    name: str,
+    tid: int,
+    pos: int,
+    seq: str,
+    cigar: Sequence[Tuple[int, int]],
+    flag: int = 0,
+    mapq: int = 60,
+) -> BamRecord:
+    return BamRecord(
+        name=name,
+        flag=flag,
+        tid=tid,
+        pos=pos,
+        mapq=mapq,
+        cigar=tuple(cigar),
+        seq=seq,
+        qual=b"I" * len(seq),
+    )
+
+
+def cigar_from_string(s: str) -> Tuple[Tuple[int, int], ...]:
+    """Parse '5M2I3M' into ((M,5),(I,2),(M,3))."""
+    out: List[Tuple[int, int]] = []
+    num = ""
+    for ch in s:
+        if ch.isdigit():
+            num += ch
+        else:
+            out.append((C.CIGAR_OPS.index(ch), int(num)))
+            num = ""
+    return tuple(out)
+
+
+def query_len_for_cigar(cigar: Sequence[Tuple[int, int]]) -> int:
+    return sum(l for op, l in cigar if C.CIGAR_CONSUMES_QUERY[op])
+
+
+def simulate_reads(
+    rng: random.Random,
+    ref: str,
+    tid: int,
+    coverage: int = 30,
+    read_len: int = 200,
+    sub_rate: float = 0.02,
+    ins_rate: float = 0.01,
+    del_rate: float = 0.01,
+) -> List[BamRecord]:
+    """Simulate noisy reads from `ref` with known (exact) alignments: errors
+    are introduced with matching CIGAR ops, so the BAM is self-consistent
+    without needing an aligner."""
+    n_reads = max(1, coverage * len(ref) // read_len)
+    records = []
+    for ridx in range(n_reads):
+        start = rng.randrange(0, max(1, len(ref) - read_len))
+        end = min(len(ref), start + read_len)
+        seq_parts: List[str] = []
+        cigar: List[Tuple[int, int]] = []
+
+        def push(op: int, length: int):
+            if length <= 0:
+                return
+            if cigar and cigar[-1][0] == op:
+                cigar[-1] = (op, cigar[-1][1] + length)
+            else:
+                cigar.append((op, length))
+
+        i = start
+        while i < end:
+            r = rng.random()
+            if r < del_rate and i > start:
+                d = rng.randint(1, 2)
+                d = min(d, end - i)
+                push(C.CIGAR_D, d)
+                i += d
+                continue
+            b = ref[i]
+            if r < del_rate + sub_rate:
+                b = rng.choice([x for x in BASES if x != ref[i]])
+            seq_parts.append(b)
+            push(C.CIGAR_M, 1)
+            if rng.random() < ins_rate:
+                ins = random_seq(rng, rng.randint(1, 2))
+                seq_parts.append(ins)
+                push(C.CIGAR_I, len(ins))
+            i += 1
+        seq = "".join(seq_parts)
+        if not seq:
+            continue
+        flag = C.FLAG_REVERSE if rng.random() < 0.5 else 0
+        records.append(
+            make_record(f"read{ridx}", tid, start, seq, cigar, flag=flag, mapq=60)
+        )
+    return records

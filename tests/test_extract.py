@@ -1,0 +1,175 @@
+import numpy as np
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.config import WindowConfig
+from roko_tpu.features.extract import extract_windows
+from roko_tpu.io.bam import BamReader, write_sorted_bam
+from roko_tpu.utils.rng import SplitMix64
+
+from .helpers import cigar_from_string, make_record, random_seq, simulate_reads
+
+SMALL = WindowConfig(rows=4, cols=6, stride=2, max_ins=2)
+
+
+def _bam(tmp_path, records, refs=(("ctg", 100000),)):
+    path = str(tmp_path / "e.bam")
+    write_sorted_bam(path, list(refs), records)
+    return path
+
+
+def _windows(path, start, end, seed=7, cfg=SMALL):
+    with BamReader(path) as reader:
+        return list(extract_windows(reader, "ctg", start, end, seed, cfg))
+
+
+def test_single_read_window_values(tmp_path):
+    # one forward read covering 8 positions: first window = cols 0..5
+    rec = make_record("r0", 0, 0, "ACGTACGT", cigar_from_string("8M"))
+    path = _bam(tmp_path, [rec])
+    wins = _windows(path, 0, 8)
+    assert len(wins) >= 1
+    w = wins[0]
+    np.testing.assert_array_equal(w.positions[:, 0], np.arange(6))
+    np.testing.assert_array_equal(w.positions[:, 1], np.zeros(6))
+    # only one valid read: every sampled row is that read
+    expected = np.array([0, 1, 2, 3, 0, 1], dtype=np.uint8)  # ACGTAC
+    for r in range(SMALL.rows):
+        np.testing.assert_array_equal(w.matrix[r], expected)
+
+
+def test_reverse_strand_offset(tmp_path):
+    rec = make_record(
+        "r0", 0, 0, "ACGTAC", cigar_from_string("6M"), flag=C.FLAG_REVERSE
+    )
+    path = _bam(tmp_path, [rec])
+    (w,) = _windows(path, 0, 6)
+    expected = np.array([0, 1, 2, 3, 0, 1], dtype=np.uint8) + C.STRAND_OFFSET
+    np.testing.assert_array_equal(w.matrix[0], expected)
+
+
+def test_gap_vs_unknown_bounds_rule(tmp_path):
+    # read A spans all 6 columns; read B only columns 2-3. For B's rows,
+    # columns 0-1 are before its alignment => UNKNOWN; column 4 EQUALS its
+    # exclusive ref_end, which the reference's `pos > bam_endpos` test
+    # (generate.cpp:135) counts as in-bounds => GAP; column 5 => UNKNOWN.
+    recs = [
+        make_record("A", 0, 0, "ACGTAC", cigar_from_string("6M")),
+        make_record("B", 0, 2, "GT", cigar_from_string("2M")),
+    ]
+    path = _bam(tmp_path, recs)
+    (w,) = _windows(path, 0, 6)
+    rows = {tuple(r) for r in w.matrix.tolist()}
+    row_a = (0, 1, 2, 3, 0, 1)
+    u, g = C.ENCODED_UNKNOWN, C.ENCODED_GAP
+    row_b = (u, u, 2, 3, g, u)
+    assert rows <= {row_a, row_b}
+    # with seed=7 both reads should get sampled across 4 rows
+    assert rows == {row_a, row_b}
+
+
+def test_boundary_pos_equal_ref_end_is_gap(tmp_path):
+    # The reference tests `pos > bounds.second` with bounds.second =
+    # exclusive bam_endpos (generate.cpp:135): the position EQUAL to
+    # ref_end is "in bounds" and renders GAP, not UNKNOWN. Read B spans
+    # cols 0-2 (ref_end=3); at column 3 it must render GAP; at column 4+,
+    # UNKNOWN.
+    recs = [
+        make_record("A", 0, 0, "ACGTAC", cigar_from_string("6M")),
+        make_record("B", 0, 0, "ACG", cigar_from_string("3M")),
+    ]
+    path = _bam(tmp_path, recs)
+    (w,) = _windows(path, 0, 6)
+    g, u = C.ENCODED_GAP, C.ENCODED_UNKNOWN
+    row_b = (0, 1, 2, g, u, u)
+    assert tuple(w.matrix[3].tolist()) == row_b or row_b in {
+        tuple(r) for r in w.matrix.tolist()
+    }
+
+
+def test_deletion_renders_gap(tmp_path):
+    recs = [
+        make_record("A", 0, 0, "ACGTAC", cigar_from_string("6M")),
+        make_record("B", 0, 0, "ACAC", cigar_from_string("2M2D2M")),
+    ]
+    path = _bam(tmp_path, recs)
+    (w,) = _windows(path, 0, 6)
+    g = C.ENCODED_GAP
+    row_b = (0, 1, g, g, 0, 1)
+    assert row_b in {tuple(r) for r in w.matrix.tolist()}
+
+
+def test_insertion_slots(tmp_path):
+    # read B has a 2-base insertion after position 2 -> columns (2,1),(2,2)
+    recs = [
+        make_record("A", 0, 0, "ACGT", cigar_from_string("4M")),
+        make_record("B", 0, 0, "ACGTTAT", cigar_from_string("3M3I1M")),
+    ]
+    path = _bam(tmp_path, recs)
+    cfg = WindowConfig(rows=4, cols=6, stride=2, max_ins=2)
+    (w,) = _windows(path, 0, 4, cfg=cfg)
+    # expected columns: (0,0) (1,0) (2,0) (2,1) (2,2) (3,0); max_ins caps
+    # the 3I at 2 slots
+    np.testing.assert_array_equal(
+        w.positions, np.array([[0, 0], [1, 0], [2, 0], [2, 1], [2, 2], [3, 0]])
+    )
+    rows = {tuple(r) for r in w.matrix.tolist()}
+    g = C.ENCODED_GAP
+    # read A: aligned-but-absent at insertion slots -> GAP
+    row_a = (0, 1, 2, g, g, 3)
+    # read B: insertion bases T, T at the first two slots (the 3rd is
+    # capped away by max_ins=2)
+    row_b = (0, 1, 2, 3, 3, 3)
+    assert rows == {row_a, row_b}
+
+
+def test_window_sliding_and_overlap(tmp_path):
+    # 10 positions, cols=6, stride=2 -> windows at 0,2,4; positions 0-5,
+    # 2-7, 4-9; leftover (8,9 alone) dropped
+    rec = make_record("r0", 0, 0, "ACGTACGTAC", cigar_from_string("10M"))
+    path = _bam(tmp_path, [rec])
+    wins = _windows(path, 0, 10)
+    starts = [int(w.positions[0, 0]) for w in wins]
+    assert starts == [0, 2, 4]
+    np.testing.assert_array_equal(wins[2].positions[:, 0], np.arange(4, 10))
+
+
+def test_region_bounds_respected(tmp_path):
+    rec = make_record("r0", 0, 0, "ACGTACGTAC", cigar_from_string("10M"))
+    path = _bam(tmp_path, [rec])
+    wins = _windows(path, 2, 8)
+    for w in wins:
+        assert w.positions[:, 0].min() >= 2
+        assert w.positions[:, 0].max() < 8
+
+
+def test_seed_determinism(tmp_path, py_random):
+    ref = random_seq(py_random, 2000)
+    recs = simulate_reads(py_random, ref, 0, coverage=10, read_len=300)
+    path = _bam(tmp_path, recs)
+    cfg = WindowConfig()  # full-size 200x90
+    w1 = _windows(path, 0, 2000, seed=42, cfg=cfg)
+    w2 = _windows(path, 0, 2000, seed=42, cfg=cfg)
+    w3 = _windows(path, 0, 2000, seed=43, cfg=cfg)
+    assert len(w1) == len(w2) == len(w3) > 0
+    for a, b in zip(w1, w2):
+        np.testing.assert_array_equal(a.matrix, b.matrix)
+        np.testing.assert_array_equal(a.positions, b.positions)
+    assert any(
+        not np.array_equal(a.matrix, b.matrix) for a, b in zip(w1, w3)
+    )
+
+
+def test_full_size_window_shape_and_vocab(tmp_path, py_random):
+    ref = random_seq(py_random, 5000)
+    recs = simulate_reads(py_random, ref, 0, coverage=20, read_len=400)
+    path = _bam(tmp_path, recs)
+    wins = _windows(path, 0, 5000, cfg=WindowConfig())
+    assert wins
+    for w in wins:
+        assert w.matrix.shape == (C.WINDOW_ROWS, C.WINDOW_COLS)
+        assert w.matrix.dtype == np.uint8
+        assert w.positions.shape == (C.WINDOW_COLS, 2)
+        assert int(w.matrix.max()) < C.FEATURE_VOCAB
+        # insertion slots bounded by MAX_INS
+        assert int(w.positions[:, 1].max()) <= C.MAX_INS

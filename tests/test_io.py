@@ -1,0 +1,182 @@
+import random
+
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.io.bam import BamReader, BamRecord, BamWriter, write_sorted_bam
+from roko_tpu.io.bgzf import EOF_MARKER, BgzfReader, BgzfWriter
+from roko_tpu.io.fasta import read_fasta, write_fasta
+
+from .helpers import cigar_from_string, make_record, random_seq, simulate_reads
+
+
+# ---------------------------------------------------------------- FASTA
+def test_fasta_roundtrip(tmp_path):
+    recs = [("contig1", "ACGT" * 50), ("contig2 extra desc".split()[0], "TTTT")]
+    path = str(tmp_path / "x.fasta")
+    write_fasta(path, recs, line_width=13)
+    assert read_fasta(path) == recs
+
+
+def test_fasta_header_token(tmp_path):
+    path = str(tmp_path / "x.fasta")
+    with open(path, "w") as fh:
+        fh.write(">ctg1 length=100 foo\nACGT\nACGT\n")
+    assert read_fasta(path) == [("ctg1", "ACGTACGT")]
+
+
+# ---------------------------------------------------------------- BGZF
+def test_bgzf_roundtrip_small(tmp_path):
+    path = str(tmp_path / "x.bgzf")
+    data = b"hello bgzf world" * 3
+    with BgzfWriter(path) as w:
+        w.write(data)
+    with BgzfReader(path) as r:
+        assert r.read(len(data) + 10) == data
+
+
+def test_bgzf_roundtrip_multiblock(tmp_path, py_random):
+    path = str(tmp_path / "big.bgzf")
+    data = bytes(py_random.randrange(256) for _ in range(300_000))
+    with BgzfWriter(path) as w:
+        # write in awkward chunk sizes to exercise buffering
+        for i in range(0, len(data), 70_001):
+            w.write(data[i : i + 70_001])
+    with BgzfReader(path) as r:
+        out = bytearray()
+        while True:
+            chunk = r.read(12_345)
+            if not chunk:
+                break
+            out.extend(chunk)
+        assert bytes(out) == data
+
+
+def test_bgzf_virtual_offsets(tmp_path):
+    path = str(tmp_path / "v.bgzf")
+    blocks = [bytes([i]) * 1000 for i in range(5)]
+    offsets = []
+    with BgzfWriter(path) as w:
+        for b in blocks:
+            offsets.append(w.tell_virtual())
+            w.write(b)
+            w.flush()  # force block boundary per write
+    with BgzfReader(path) as r:
+        for off, b in zip(offsets, blocks):
+            r.seek_virtual(off)
+            assert r.read(1000) == b
+
+
+def test_bgzf_eof_marker(tmp_path):
+    path = str(tmp_path / "x.bgzf")
+    with BgzfWriter(path) as w:
+        w.write(b"data")
+    raw = open(path, "rb").read()
+    assert raw.endswith(EOF_MARKER)
+
+
+# ---------------------------------------------------------------- BAM
+def _roundtrip(tmp_path, records, refs):
+    path = str(tmp_path / "t.bam")
+    write_sorted_bam(path, refs, records)
+    with BamReader(path) as r:
+        assert r.references == list(refs)
+        return list(r)
+
+
+def test_bam_record_roundtrip(tmp_path):
+    refs = [("ctg1", 10000)]
+    rec = make_record("r1", 0, 42, "ACGTN", cigar_from_string("3M1I1M"), flag=16, mapq=7)
+    rec.tags = b"NMC\x01"
+    (got,) = _roundtrip(tmp_path, [rec], refs)
+    assert got.name == "r1"
+    assert got.flag == 16
+    assert got.pos == 42
+    assert got.mapq == 7
+    assert got.cigar == cigar_from_string("3M1I1M")
+    assert got.seq == "ACGTN"
+    assert got.tags == b"NMC\x01"
+    assert got.is_reverse
+
+
+def test_bam_odd_length_seq(tmp_path):
+    refs = [("c", 1000)]
+    rec = make_record("r", 0, 0, "ACG", cigar_from_string("3M"))
+    (got,) = _roundtrip(tmp_path, [rec], refs)
+    assert got.seq == "ACG"
+
+
+def test_reference_end():
+    rec = make_record("r", 0, 10, "A" * 10, cigar_from_string("2S5M2D3M"))
+    # consumes ref: 5M + 2D + 3M = 10
+    assert rec.reference_end == 20
+    assert rec.reference_length == 10
+
+
+def test_aligned_pairs_pysam_semantics():
+    # 2S3M1I2M2D1M: soft clips and insertions yield (qpos, None),
+    # deletions yield (None, rpos)
+    rec = make_record("r", 0, 100, "AAACGTCGA", cigar_from_string("2S3M1I2M2D1M"))
+    pairs = rec.get_aligned_pairs()
+    assert pairs == [
+        (0, None), (1, None),          # soft clip
+        (2, 100), (3, 101), (4, 102),  # 3M
+        (5, None),                     # 1I
+        (6, 103), (7, 104),            # 2M
+        (None, 105), (None, 106),      # 2D
+        (8, 107),                      # 1M
+    ]
+
+
+def test_fetch_with_index(tmp_path, py_random):
+    ref = random_seq(py_random, 60_000)
+    refs = [("ctg", len(ref))]
+    records = simulate_reads(py_random, ref, 0, coverage=5, read_len=500)
+    path = str(tmp_path / "f.bam")
+    write_sorted_bam(path, refs, records)
+
+    with BamReader(path) as r:
+        start, end = 30_000, 31_000
+        got = {rec.name for rec in r.fetch("ctg", start, end)}
+        expected = {
+            rec.name
+            for rec in sorted(records, key=lambda x: x.pos)
+            if rec.pos < end and rec.reference_end > start
+        }
+        assert got == expected
+
+        # whole-contig fetch returns everything, in coordinate order
+        all_got = [rec.pos for rec in r.fetch("ctg")]
+        assert all_got == sorted(all_got)
+        assert len(all_got) == len(records)
+
+
+def test_fetch_multi_contig(tmp_path, py_random):
+    refs = [("a", 5000), ("b", 5000)]
+    ra = simulate_reads(py_random, random_seq(py_random, 5000), 0, coverage=3)
+    rb = simulate_reads(py_random, random_seq(py_random, 5000), 1, coverage=3)
+    path = str(tmp_path / "m.bam")
+    write_sorted_bam(path, refs, ra + rb)
+    with BamReader(path) as r:
+        got_b = list(r.fetch("b", 0, 5000))
+        assert got_b and all(rec.tid == 1 for rec in got_b)
+        assert len(got_b) == len(rb)
+        got_a = list(r.fetch("a", 1000, 1500))
+        assert all(rec.tid == 0 for rec in got_a)
+
+
+def test_fetch_unknown_contig(tmp_path, py_random):
+    refs = [("a", 1000)]
+    path = str(tmp_path / "u.bam")
+    write_sorted_bam(path, refs, [make_record("r", 0, 0, "ACGT", cigar_from_string("4M"))])
+    with BamReader(path) as r:
+        with pytest.raises(KeyError):
+            list(r.fetch("nope"))
+
+
+def test_writer_rejects_unsorted(tmp_path):
+    refs = [("a", 1000)]
+    w = BamWriter(str(tmp_path / "s.bam"), refs)
+    w.write(make_record("r1", 0, 100, "ACGT", cigar_from_string("4M")))
+    with pytest.raises(ValueError):
+        w.write(make_record("r2", 0, 50, "ACGT", cigar_from_string("4M")))

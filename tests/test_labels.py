@@ -1,0 +1,148 @@
+import numpy as np
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.features.labels import (
+    Region,
+    TargetAlign,
+    filter_aligns,
+    get_aligns,
+    get_pos_and_labels,
+)
+from roko_tpu.io.bam import BamReader, write_sorted_bam
+
+from .helpers import cigar_from_string, make_record
+
+
+def _target(pos, ref_len, name="t", seq=None):
+    cigar = cigar_from_string(f"{ref_len}M")
+    seq = seq or "A" * ref_len
+    rec = make_record(name, 0, pos, seq, cigar)
+    return TargetAlign(rec, rec.reference_start, rec.reference_end, True)
+
+
+# ------------------------------------------------------------- filter_aligns
+def test_filter_case1_similar_lengths_big_overlap_drops_both():
+    a = _target(0, 2000)
+    b = _target(500, 2000)  # overlap 1500 / 2000 = 0.75 >= 0.5; ratio 1.0 < 2
+    out = filter_aligns([a, b])
+    assert out == []
+
+
+def test_filter_case2_similar_lengths_small_overlap_splits():
+    a = _target(0, 3000)
+    b = _target(2500, 3000)  # overlap 500/3000 < 0.5; ratio 1 < 2
+    out = filter_aligns([a, b])
+    assert len(out) == 2
+    first, second = out
+    assert first.end == 2500  # clipped at overlap start
+    assert second.start == 3000  # starts after old first.end
+
+
+def test_filter_case3_very_different_lengths_big_overlap_drops_shorter():
+    a = _target(0, 10000)
+    b = _target(1000, 1200)  # fully inside a; ratio >= 2; ol/short = 1 >= 0.5
+    out = filter_aligns([a, b])
+    assert [t.align.name for t in out] == ["t"]
+    assert out[0].reference_length == 10000
+
+
+def test_filter_case4_very_different_lengths_small_overlap_clips_shorter():
+    a = _target(0, 10000)
+    b = _target(9500, 3000)  # overlap 500/3000 < 0.5, ratio >= 2
+    out = filter_aligns([a, b])
+    assert len(out) == 2
+    # second (by start) gets clipped to start at first.end
+    bb = [t for t in out if t.align.reference_start == 9500][0]
+    assert bb.start == 10000
+
+
+def test_filter_min_len():
+    a = _target(0, 800)  # shorter than min_len=1000
+    out = filter_aligns([a])
+    assert out == []
+    out2 = filter_aligns([a], min_len=500)
+    assert len(out2) == 1
+
+
+def test_filter_sorts_by_clipped_start():
+    a = _target(0, 5000)
+    b = _target(6000, 5000)
+    out = filter_aligns([b, a])
+    assert [t.start for t in out] == [0, 6000]
+
+
+# ------------------------------------------------------------- get_aligns
+def test_get_aligns_skips_secondary_and_sorts(tmp_path):
+    refs = [("draft", 100000)]
+    recs = [
+        make_record("sec", 0, 10, "A" * 2000, cigar_from_string("2000M"), flag=C.FLAG_SECONDARY),
+        make_record("one", 0, 5000, "A" * 2000, cigar_from_string("2000M")),
+        make_record("two", 0, 100, "A" * 2000, cigar_from_string("2000M")),
+    ]
+    path = str(tmp_path / "t.bam")
+    write_sorted_bam(path, refs, recs)
+    with BamReader(path) as r:
+        out = get_aligns(r, "draft", 0, 100000)
+    assert [t.align.name for t in out] == ["two", "one"]
+
+
+# ------------------------------------------------------- get_pos_and_labels
+def test_labels_match_only():
+    t = _target(10, 20, seq="ACGTACGTACGTACGTACGT")
+    region = Region("draft", 0, 1000)
+    pos, labels = get_pos_and_labels(t, region)
+    assert pos == [(10 + i, 0) for i in range(20)]
+    assert labels == [C.ENCODING[b] for b in "ACGTACGTACGTACGTACGT"]
+
+
+def test_labels_insertion_increments_slot():
+    # 3M2I3M at pos 0: truth has 2 extra bases after draft pos 2
+    rec = make_record("t", 0, 0, "ACGTTACG", cigar_from_string("3M2I3M"))
+    t = TargetAlign(rec, rec.reference_start, rec.reference_end)
+    pos, labels = get_pos_and_labels(t, Region("d", 0, 100))
+    assert pos == [(0, 0), (1, 0), (2, 0), (2, 1), (2, 2), (3, 0), (4, 0), (5, 0)]
+    assert labels == [
+        C.ENCODING[b] for b in ["A", "C", "G", "T", "T", "A", "C", "G"]
+    ]
+
+
+def test_labels_deletion_labels_gap():
+    # 2M2D2M: draft positions 2,3 are deleted in truth -> GAP labels
+    rec = make_record("t", 0, 0, "ACAC", cigar_from_string("2M2D2M"))
+    t = TargetAlign(rec, rec.reference_start, rec.reference_end)
+    pos, labels = get_pos_and_labels(t, Region("d", 0, 100))
+    assert pos == [(0, 0), (1, 0), (2, 0), (3, 0), (4, 0), (5, 0)]
+    assert labels == [0, 1, C.ENCODED_GAP, C.ENCODED_GAP, 0, 1]
+
+
+def test_labels_n_base_is_unknown():
+    rec = make_record("t", 0, 0, "ACNT", cigar_from_string("4M"))
+    t = TargetAlign(rec, rec.reference_start, rec.reference_end)
+    _, labels = get_pos_and_labels(t, Region("d", 0, 100))
+    assert labels == [0, 1, C.ENCODED_UNKNOWN, 3]
+
+
+def test_labels_respect_clipped_span():
+    rec = make_record("t", 0, 0, "ACGTACGTAC", cigar_from_string("10M"))
+    t = TargetAlign(rec, 2, 7)  # clipped bounds
+    pos, labels = get_pos_and_labels(t, Region("d", 0, 100))
+    assert pos == [(i, 0) for i in range(2, 7)]
+    assert labels == [C.ENCODING[b] for b in "GTACG"]
+
+
+def test_labels_region_bounds():
+    rec = make_record("t", 0, 0, "ACGTACGTAC", cigar_from_string("10M"))
+    t = TargetAlign(rec, rec.reference_start, rec.reference_end)
+    pos, labels = get_pos_and_labels(t, Region("d", 3, 6))
+    assert pos == [(3, 0), (4, 0), (5, 0)]
+
+
+def test_labels_leading_insertions_dropped():
+    # soft-clip + insertion pairs before the span must be dropped by the
+    # dropwhile (rpos None or < start)
+    rec = make_record("t", 0, 5, "TTACGT", cigar_from_string("2S4M"))
+    t = TargetAlign(rec, rec.reference_start, rec.reference_end)
+    pos, labels = get_pos_and_labels(t, Region("d", 0, 100))
+    assert pos == [(5, 0), (6, 0), (7, 0), (8, 0)]
+    assert labels == [C.ENCODING[b] for b in "ACGT"]

@@ -1,0 +1,118 @@
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.config import ReadFilterConfig
+from roko_tpu.features.pileup import passes_filter, pileup_columns
+from roko_tpu.io.bam import BamReader, write_sorted_bam
+
+from .helpers import cigar_from_string, make_record
+
+
+def _bam(tmp_path, records, refs=(("ctg", 100000),)):
+    path = str(tmp_path / "p.bam")
+    write_sorted_bam(path, list(refs), records)
+    return path
+
+
+def test_filter_policy():
+    cfg = ReadFilterConfig()
+    ok = make_record("r", 0, 0, "ACGT", cigar_from_string("4M"), mapq=10)
+    assert passes_filter(ok, cfg)
+    low_mapq = make_record("r", 0, 0, "ACGT", cigar_from_string("4M"), mapq=9)
+    assert not passes_filter(low_mapq, cfg)
+    for flag in (C.FLAG_UNMAP, C.FLAG_SECONDARY, C.FLAG_QCFAIL, C.FLAG_DUP, C.FLAG_SUPPLEMENTARY):
+        assert not passes_filter(
+            make_record("r", 0, 0, "ACGT", cigar_from_string("4M"), flag=flag), cfg
+        )
+    # paired but not proper pair -> dropped; proper pair -> kept
+    assert not passes_filter(
+        make_record("r", 0, 0, "ACGT", cigar_from_string("4M"), flag=C.FLAG_PAIRED), cfg
+    )
+    assert passes_filter(
+        make_record(
+            "r", 0, 0, "ACGT", cigar_from_string("4M"),
+            flag=C.FLAG_PAIRED | C.FLAG_PROPER_PAIR,
+        ),
+        cfg,
+    )
+
+
+def test_columns_simple_match(tmp_path):
+    # one read, 5M at pos 10
+    path = _bam(tmp_path, [make_record("r0", 0, 10, "ACGTA", cigar_from_string("5M"))])
+    with BamReader(path) as reader:
+        cols = list(pileup_columns(reader, "ctg", 0, 1000))
+    assert [pos for pos, _ in cols] == [10, 11, 12, 13, 14]
+    for i, (pos, entries) in enumerate(cols):
+        (e,) = entries
+        assert e.read_id == 0
+        assert e.qpos == i
+        assert not e.is_del and not e.is_refskip and e.indel == 0
+
+
+def test_columns_insertion_and_deletion(tmp_path):
+    # 2M 2I 2M 2D 2M: insertion recorded on the column before it; deletion
+    # columns flagged is_del with a negative indel on the preceding column
+    rec = make_record("r0", 0, 100, "AACCGGTT", cigar_from_string("2M2I2M2D2M"))
+    path = _bam(tmp_path, [rec])
+    with BamReader(path) as reader:
+        cols = {pos: entries[0] for pos, entries in pileup_columns(reader, "ctg", 0, 1000)}
+    assert sorted(cols) == [100, 101, 102, 103, 104, 105, 106, 107]
+    assert cols[100].indel == 0
+    assert cols[101].indel == 2  # insertion follows
+    assert cols[102].qpos == 4  # after the 2I, query resumes at offset 4
+    assert cols[103].indel == -2  # deletion follows
+    assert cols[104].is_del and cols[105].is_del
+    assert cols[106].qpos == 6 and not cols[106].is_del
+
+
+def test_columns_refskip(tmp_path):
+    rec = make_record("r0", 0, 0, "AACC", cigar_from_string("2M3N2M"))
+    path = _bam(tmp_path, [rec])
+    with BamReader(path) as reader:
+        cols = {pos: entries[0] for pos, entries in pileup_columns(reader, "ctg", 0, 1000)}
+    assert all(cols[p].is_refskip for p in (2, 3, 4))
+    assert not cols[0].is_refskip and not cols[5].is_refskip
+
+
+def test_read_ids_in_file_order_and_column_order(tmp_path):
+    recs = [
+        make_record("a", 0, 10, "AAAA", cigar_from_string("4M")),
+        make_record("b", 0, 12, "CCCC", cigar_from_string("4M")),
+        make_record("c", 0, 12, "GGGG", cigar_from_string("4M")),
+    ]
+    path = _bam(tmp_path, recs)
+    with BamReader(path) as reader:
+        cols = dict(pileup_columns(reader, "ctg", 0, 1000))
+    # read ids are serial in file order
+    assert [e.read_id for e in cols[10]] == [0]
+    assert [e.read_id for e in cols[13]] == [0, 1, 2]
+    names = [e.record.name for e in cols[13]]
+    assert names == ["a", "b", "c"]
+    # coverage ends
+    assert [e.read_id for e in cols[15]] == [1, 2]
+
+
+def test_filtered_reads_excluded_from_columns(tmp_path):
+    recs = [
+        make_record("good", 0, 10, "AAAA", cigar_from_string("4M")),
+        make_record("dup", 0, 10, "CCCC", cigar_from_string("4M"), flag=C.FLAG_DUP),
+        make_record("lowq", 0, 10, "GGGG", cigar_from_string("4M"), mapq=1),
+    ]
+    path = _bam(tmp_path, recs)
+    with BamReader(path) as reader:
+        cols = dict(pileup_columns(reader, "ctg", 0, 1000))
+    assert [e.record.name for e in cols[10]] == ["good"]
+    # the surviving read still gets id 0 (ids count filtered reads only)
+    assert cols[10][0].read_id == 0
+
+
+def test_uncovered_positions_yield_no_column(tmp_path):
+    recs = [
+        make_record("a", 0, 10, "AA", cigar_from_string("2M")),
+        make_record("b", 0, 20, "CC", cigar_from_string("2M")),
+    ]
+    path = _bam(tmp_path, recs)
+    with BamReader(path) as reader:
+        positions = [p for p, _ in pileup_columns(reader, "ctg", 0, 1000)]
+    assert positions == [10, 11, 20, 21]

@@ -1,0 +1,152 @@
+import h5py
+import numpy as np
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.config import RegionConfig, RokoConfig, WindowConfig
+from roko_tpu.data.hdf5 import (
+    DataWriter,
+    iter_inference_windows,
+    load_contigs,
+    load_training_arrays,
+)
+from roko_tpu.features.pipeline import generate_regions, run_features
+from roko_tpu.io.bam import write_sorted_bam
+from roko_tpu.io.fasta import write_fasta
+
+from .helpers import cigar_from_string, make_record, random_seq, simulate_reads
+
+
+def test_generate_regions_overlap():
+    regions = list(generate_regions(250_000, "c"))
+    assert [(r.start, r.end) for r in regions] == [
+        (0, 100_000),
+        (99_700, 199_700),
+        (199_400, 250_000),
+    ]
+
+
+def test_generate_regions_short_contig():
+    regions = list(generate_regions(5_000, "c"))
+    assert [(r.start, r.end) for r in regions] == [(0, 5_000)]
+
+
+@pytest.fixture
+def synthetic(tmp_path, py_random):
+    """Draft FASTA + reads BAM + truth BAM over a small contig."""
+    draft = random_seq(py_random, 6_000)
+    fasta = str(tmp_path / "draft.fasta")
+    write_fasta(fasta, [("ctg1", draft)])
+
+    reads = simulate_reads(py_random, draft, 0, coverage=15, read_len=400)
+    bam_x = str(tmp_path / "reads.bam")
+    write_sorted_bam(bam_x, [("ctg1", len(draft))], reads)
+
+    # truth: the draft itself, one full-length alignment
+    truth_rec = make_record("truth1", 0, 0, draft, cigar_from_string(f"{len(draft)}M"))
+    bam_y = str(tmp_path / "truth.bam")
+    write_sorted_bam(bam_y, [("ctg1", len(draft))], [truth_rec])
+
+    return dict(draft=draft, fasta=fasta, bam_x=bam_x, bam_y=bam_y, tmp=tmp_path)
+
+
+def test_run_features_infer(synthetic):
+    out = str(synthetic["tmp"] / "infer.hdf5")
+    n = run_features(synthetic["fasta"], synthetic["bam_x"], out, seed=5)
+    assert n > 0
+
+    contigs = load_contigs(out)
+    assert contigs == {"ctg1": synthetic["draft"]}
+
+    with h5py.File(out, "r") as fd:
+        groups = [g for g in fd if g != "contigs"]
+        assert groups
+        for g in groups:
+            assert fd[g].attrs["contig"] == "ctg1"
+            ex = fd[g]["examples"]
+            pos = fd[g]["positions"]
+            assert ex.shape[1:] == (C.WINDOW_ROWS, C.WINDOW_COLS)
+            assert ex.dtype == np.uint8
+            assert pos.shape[1:] == (C.WINDOW_COLS, 2)
+            assert pos.dtype == np.int64
+            assert "labels" not in fd[g]
+            assert fd[g].attrs["size"] == ex.shape[0]
+
+    batches = list(iter_inference_windows(out, batch_size=7))
+    total = sum(len(c) for c, _, _ in batches)
+    assert total == n
+
+
+def test_run_features_train(synthetic):
+    out = str(synthetic["tmp"] / "train.hdf5")
+    n = run_features(
+        synthetic["fasta"], synthetic["bam_x"], out, bam_y=synthetic["bam_y"], seed=5
+    )
+    assert n > 0
+
+    X, Y = load_training_arrays(out)
+    assert X.shape == (n, C.WINDOW_ROWS, C.WINDOW_COLS)
+    assert Y.shape == (n, C.WINDOW_COLS)
+    assert Y.min() >= 0
+    # truth == draft: every base-slot label is the draft base, every
+    # labeled window avoids UNKNOWN
+    assert Y.max() <= C.ENCODED_GAP
+
+    with h5py.File(out, "r") as fd:
+        g = [k for k in fd if k != "contigs"][0]
+        pos = fd[g]["positions"][()]
+        lab = fd[g]["labels"][()]
+        draft = synthetic["draft"]
+        base_slots = pos[..., 1] == 0
+        # labels at base slots match the draft sequence
+        draft_codes = np.array([C.ENCODING[b] for b in draft], dtype=np.int64)
+        np.testing.assert_array_equal(
+            lab[base_slots], draft_codes[pos[..., 0][base_slots]]
+        )
+
+
+def test_run_features_train_determinism(synthetic):
+    out1 = str(synthetic["tmp"] / "t1.hdf5")
+    out2 = str(synthetic["tmp"] / "t2.hdf5")
+    run_features(
+        synthetic["fasta"], synthetic["bam_x"], out1, bam_y=synthetic["bam_y"], seed=9
+    )
+    run_features(
+        synthetic["fasta"], synthetic["bam_x"], out2, bam_y=synthetic["bam_y"], seed=9
+    )
+    x1, y1 = load_training_arrays(out1)
+    x2, y2 = load_training_arrays(out2)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+
+
+def test_run_features_multiprocess_matches_serial(synthetic):
+    cfg = RokoConfig(region=RegionConfig(size=2_000, overlap=100))
+    out1 = str(synthetic["tmp"] / "s.hdf5")
+    out2 = str(synthetic["tmp"] / "m.hdf5")
+    n1 = run_features(
+        synthetic["fasta"], synthetic["bam_x"], out1, seed=3, config=cfg, workers=1
+    )
+    n2 = run_features(
+        synthetic["fasta"], synthetic["bam_x"], out2, seed=3, config=cfg, workers=3
+    )
+    assert n1 == n2
+    b1 = list(iter_inference_windows(out1, 64))
+    b2 = list(iter_inference_windows(out2, 64))
+    for (c1, p1, x1), (c2, p2, x2) in zip(b1, b2):
+        np.testing.assert_array_equal(x1, x2)
+        np.testing.assert_array_equal(p1, p2)
+
+
+def test_datawriter_group_name_collision(tmp_path):
+    out = str(tmp_path / "c.hdf5")
+    pos = [np.zeros((4, 2), dtype=np.int64)]
+    X = [np.zeros((3, 4), dtype=np.uint8)]
+    with DataWriter(out, infer=True) as w:
+        w.store("c", pos, X, None)
+        w.write()
+        w.store("c", pos, X, None)
+        w.write()
+    with h5py.File(out, "r") as fd:
+        groups = sorted(fd.keys())
+        assert groups == ["c_0-0", "c_0-0.1"]
