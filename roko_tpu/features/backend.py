@@ -11,11 +11,8 @@ from roko_tpu.config import ReadFilterConfig, WindowConfig
 from roko_tpu.features.extract import Window, extract_windows
 from roko_tpu.io.bam import BamReader
 
-_FORCE_PY = os.environ.get("ROKO_TPU_FORCE_PY_EXTRACTOR", "") == "1"
-
-
 def _native_available() -> bool:
-    if _FORCE_PY:
+    if os.environ.get("ROKO_TPU_FORCE_PY_EXTRACTOR", "") == "1":
         return False
     try:
         from roko_tpu.native import binding  # noqa: F401

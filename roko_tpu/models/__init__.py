@@ -1,0 +1,10 @@
+from roko_tpu.models.gru import RokoGRU, bidir_gru_stack
+from roko_tpu.models.model import RokoModel, build_model, init_params
+
+__all__ = [
+    "RokoGRU",
+    "RokoModel",
+    "bidir_gru_stack",
+    "build_model",
+    "init_params",
+]

@@ -1,0 +1,69 @@
+"""One-shot torch -> param-pytree checkpoint converter.
+
+Maps the reference's ``state_dict`` layout (ref: roko/rnn_model.py —
+``embedding``, ``fc1``, ``fc2``, ``gru.weight_ih_l{k}[_reverse]``,
+``fc4``) onto :class:`roko_tpu.models.RokoModel` params, so the published
+``r10_2.3.8.pth`` checkpoint (ref: README.md:115) runs unchanged on TPU.
+
+Layout differences handled here:
+- torch ``nn.Linear.weight`` is [out, in]; we store [in, out] kernels.
+- torch GRU weights are [3H, in] with gate order (r, z, n); we store the
+  transpose [in, 3H] with the same gate order, so no gate reshuffling is
+  needed — only a transpose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import numpy as np
+
+from roko_tpu.config import ModelConfig
+from roko_tpu.models.model import Params
+
+
+def _np(t: Any) -> np.ndarray:
+    """torch.Tensor | ndarray -> float32 ndarray (no torch import needed
+    unless a tensor is actually passed)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def from_torch_state_dict(
+    sd: Mapping[str, Any], cfg: ModelConfig | None = None
+) -> Params:
+    cfg = cfg or ModelConfig()
+    if cfg.kind != "gru":
+        raise ValueError("torch conversion only defined for the GRU model")
+
+    params: Dict[str, Any] = {
+        "embedding": _np(sd["embedding.weight"]),
+        "fc1": {"kernel": _np(sd["fc1.weight"]).T, "bias": _np(sd["fc1.bias"])},
+        "fc2": {"kernel": _np(sd["fc2.weight"]).T, "bias": _np(sd["fc2.bias"])},
+        "head": {"kernel": _np(sd["fc4.weight"]).T, "bias": _np(sd["fc4.bias"])},
+    }
+
+    layers = []
+    for k in range(cfg.num_layers):
+        layer = {}
+        for direction, suffix in (("fwd", ""), ("bwd", "_reverse")):
+            layer[direction] = {
+                "w_ih": _np(sd[f"gru.weight_ih_l{k}{suffix}"]).T,
+                "w_hh": _np(sd[f"gru.weight_hh_l{k}{suffix}"]).T,
+                "b_ih": _np(sd[f"gru.bias_ih_l{k}{suffix}"]),
+                "b_hh": _np(sd[f"gru.bias_hh_l{k}{suffix}"]),
+            }
+        layers.append(layer)
+    params["gru"] = tuple(layers)
+    return params
+
+
+def load_torch_checkpoint(path: str, cfg: ModelConfig | None = None) -> Params:
+    """Load a reference ``.pth`` state_dict file (requires torch)."""
+    import torch
+
+    sd = torch.load(path, map_location="cpu")
+    if not isinstance(sd, Mapping) or "embedding.weight" not in sd:
+        raise ValueError(f"{path} does not look like a roko RNN state_dict")
+    return from_torch_state_dict(sd, cfg)

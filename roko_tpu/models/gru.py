@@ -1,0 +1,131 @@
+"""Bidirectional multi-layer GRU with torch-exact gate semantics.
+
+The recurrence matches ``torch.nn.GRU`` bit-for-bit in float32 (ref:
+roko/rnn_model.py:40-41 uses a 3-layer bidirectional GRU): gate order is
+(r, z, n) along the stacked weight axis and the new-gate uses the two-bias
+form ``n = tanh(W_in x + b_in + r * (W_hn h + b_hn))`` — the hidden-side
+bias stays *inside* the reset-gate product, which matters for checkpoint
+parity with the published ``r10_2.3.8.pth``.
+
+TPU-first structure: the input projection ``x @ W_ih + b_ih`` for all T
+timesteps is one large MXU-friendly matmul *outside* the ``lax.scan``; the
+scan body only does the [B,H]x[H,3H] hidden matmul plus pointwise gates.
+With T=90 and H=128 the recurrence is latency-bound — hoisting the input
+projection removes two thirds of the per-step FLOPs from the serial chain.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gru_layer_params(
+    rng: jax.Array, in_size: int, hidden: int, dtype=jnp.float32
+) -> Dict[str, jax.Array]:
+    """One direction of one layer. Orthogonal init for matrices, standard
+    normal for biases (ref: roko/rnn_model.py:15-21 ``gru_init``)."""
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    ortho = jax.nn.initializers.orthogonal()
+    return {
+        # stored transposed relative to torch: [in, 3H] so x @ w_ih works
+        "w_ih": ortho(k1, (in_size, 3 * hidden), dtype),
+        "w_hh": ortho(k2, (hidden, 3 * hidden), dtype),
+        "b_ih": jax.random.normal(k3, (3 * hidden,), dtype),
+        "b_hh": jax.random.normal(k4, (3 * hidden,), dtype),
+    }
+
+
+def _gru_scan(
+    x_proj: jax.Array,  # [B,T,3H] = x @ w_ih + b_ih, precomputed
+    h0: jax.Array,  # [B,H]
+    w_hh: jax.Array,  # [H,3H]
+    b_hh: jax.Array,  # [3H]
+    reverse: bool,
+) -> jax.Array:
+    hidden = h0.shape[-1]
+
+    def cell(h, xp):
+        hp = h @ w_hh + b_hh
+        r = jax.nn.sigmoid(xp[..., :hidden] + hp[..., :hidden])
+        z = jax.nn.sigmoid(xp[..., hidden : 2 * hidden] + hp[..., hidden : 2 * hidden])
+        n = jnp.tanh(xp[..., 2 * hidden :] + r * hp[..., 2 * hidden :])
+        h_new = (1.0 - z) * n + z * h
+        return h_new, h_new
+
+    # scan over the time axis; [T,B,3H]
+    _, ys = lax.scan(cell, h0, x_proj.swapaxes(0, 1), reverse=reverse)
+    return ys.swapaxes(0, 1)  # [B,T,H]
+
+
+def gru_direction(
+    params: Dict[str, jax.Array], x: jax.Array, reverse: bool
+) -> jax.Array:
+    """Run one direction over ``x`` [B,T,in] -> [B,T,H]."""
+    hidden = params["w_hh"].shape[0]
+    x_proj = x @ params["w_ih"] + params["b_ih"]
+    h0 = jnp.zeros((x.shape[0], hidden), x_proj.dtype)
+    return _gru_scan(x_proj, h0, params["w_hh"], params["b_hh"], reverse)
+
+
+def bidir_gru_stack(
+    params: Tuple[Dict[str, Any], ...],
+    x: jax.Array,
+    *,
+    dropout: float = 0.0,
+    deterministic: bool = True,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Stacked bidirectional GRU, [B,T,in] -> [B,T,2H].
+
+    ``params`` is a tuple of ``{"fwd": layer_params, "bwd": layer_params}``.
+    Dropout is applied to each layer's output except the last, matching
+    ``torch.nn.GRU(dropout=p)`` placement (between layers only).
+    """
+    num_layers = len(params)
+    for i, layer in enumerate(params):
+        fwd = gru_direction(layer["fwd"], x, reverse=False)
+        bwd = gru_direction(layer["bwd"], x, reverse=True)
+        x = jnp.concatenate([fwd, bwd], axis=-1)
+        if dropout > 0.0 and not deterministic and i < num_layers - 1:
+            assert rng is not None
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - dropout
+            mask = jax.random.bernoulli(sub, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0)
+    return x
+
+
+class RokoGRU:
+    """Functional container: builds/holds no state, just init + apply."""
+
+    def __init__(self, in_size: int, hidden: int, num_layers: int, dropout: float):
+        self.in_size = in_size
+        self.hidden = hidden
+        self.num_layers = num_layers
+        self.dropout = dropout
+
+    def init(self, rng: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], ...]:
+        layers = []
+        for i in range(self.num_layers):
+            in_size = self.in_size if i == 0 else 2 * self.hidden
+            rng, kf, kb = jax.random.split(rng, 3)
+            layers.append(
+                {
+                    "fwd": gru_layer_params(kf, in_size, self.hidden, dtype),
+                    "bwd": gru_layer_params(kb, in_size, self.hidden, dtype),
+                }
+            )
+        return tuple(layers)
+
+    def apply(self, params, x, *, deterministic=True, rng=None):
+        return bidir_gru_stack(
+            params,
+            x,
+            dropout=self.dropout,
+            deterministic=deterministic,
+            rng=rng,
+        )

@@ -1,0 +1,167 @@
+"""The roko consensus network, TPU-native.
+
+Architecture (semantics ref: roko/rnn_model.py:24-59, shapes documented in
+SURVEY.md §3.5):
+
+```
+x: int[B,200,90] (0-11)
+embed(12,50)   -> [B,200,90,50]   dropout
+transpose      -> [B,90,50,200]   (read axis last)
+fc1 200->100   -> relu, dropout
+fc2 100->10    -> relu, dropout
+reshape        -> [B,90,500]
+bidir GRU x3 h=128 -> [B,90,256]
+head 256->5    -> logits [B,90,5]
+```
+
+Implemented as a functional param-pytree model (no framework Module): the
+params dict is the single source of truth, which keeps torch-checkpoint
+conversion (`roko_tpu/models/convert.py`), Orbax serialisation and pjit
+sharding specs trivial. All dense contractions are large batched matmuls
+that tile directly onto the MXU; `compute_dtype="bfloat16"` casts the
+matmul operands while keeping params and the final logits in float32.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from roko_tpu import constants as C
+from roko_tpu.config import ModelConfig
+from roko_tpu.models.gru import RokoGRU
+
+Params = Dict[str, Any]
+
+
+def _dense_params(rng, in_dim, out_dim, dtype=jnp.float32):
+    kkernel, kbias = jax.random.split(rng)
+    # torch nn.Linear default: U(-1/sqrt(in), 1/sqrt(in)) for both
+    bound = 1.0 / jnp.sqrt(in_dim)
+    return {
+        "kernel": jax.random.uniform(
+            kkernel, (in_dim, out_dim), dtype, -bound, bound
+        ),
+        "bias": jax.random.uniform(kbias, (out_dim,), dtype, -bound, bound),
+    }
+
+
+def _dense(p, x):
+    return x @ p["kernel"] + p["bias"]
+
+
+def _dropout(rng, x, rate):
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+class RokoModel:
+    """Functional model: ``init`` builds the param pytree, ``apply`` runs
+    the forward pass. ``apply`` is pure and jit/shard_map friendly."""
+
+    def __init__(self, cfg: Optional[ModelConfig] = None):
+        self.cfg = cfg or ModelConfig()
+        if self.cfg.kind not in ("gru", "transformer"):
+            raise ValueError(f"unknown model kind: {self.cfg.kind}")
+        if self.cfg.kind == "transformer":
+            # fail at construction, not first init/apply, if the variant
+            # is unavailable
+            from roko_tpu.models import transformer  # noqa: F401
+        self.gru = RokoGRU(
+            self.cfg.gru_in_size,
+            self.cfg.hidden_size,
+            self.cfg.num_layers,
+            self.cfg.dropout,
+        )
+
+    # -- init ---------------------------------------------------------------
+    def init(self, rng: jax.Array) -> Params:
+        cfg = self.cfg
+        keys = jax.random.split(rng, 5)
+        params: Params = {
+            # torch nn.Embedding default init: N(0, 1)
+            "embedding": jax.random.normal(
+                keys[0], (cfg.embed_vocab, cfg.embed_dim), jnp.float32
+            ),
+            "fc1": _dense_params(keys[1], C.WINDOW_ROWS, cfg.read_mlp[0]),
+            "fc2": _dense_params(keys[2], cfg.read_mlp[0], cfg.read_mlp[1]),
+            "head": _dense_params(
+                keys[3], 2 * cfg.hidden_size, cfg.num_classes
+            ),
+        }
+        if cfg.kind == "gru":
+            params["gru"] = self.gru.init(keys[4])
+        else:  # transformer params built in models/transformer.py
+            from roko_tpu.models.transformer import transformer_init
+
+            params["encoder"] = transformer_init(keys[4], cfg)
+        return params
+
+    # -- forward ------------------------------------------------------------
+    def apply(
+        self,
+        params: Params,
+        x: jax.Array,  # int[B,200,90]
+        *,
+        deterministic: bool = True,
+        rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        cfg = self.cfg
+        dtype = jnp.dtype(cfg.compute_dtype)
+        train = not deterministic
+        if train:
+            assert rng is not None, "training forward needs a dropout rng"
+            rngs = list(jax.random.split(rng, 4))
+
+        e = jnp.take(params["embedding"], x, axis=0)  # [B,200,90,50]
+        e = e.astype(dtype)
+        if train:
+            e = _dropout(rngs[0], e, cfg.dropout)
+
+        # read axis (200) to the back: [B,90,50,200]
+        e = e.transpose(0, 2, 3, 1)
+
+        h = jax.nn.relu(_dense(jax.tree.map(lambda a: a.astype(dtype), params["fc1"]), e))
+        if train:
+            h = _dropout(rngs[1], h, cfg.dropout)
+        h = jax.nn.relu(_dense(jax.tree.map(lambda a: a.astype(dtype), params["fc2"]), h))
+        if train:
+            h = _dropout(rngs[2], h, cfg.dropout)
+
+        # [B,90,50,10] -> [B,90,500]; row-major flatten matches the
+        # reference's .reshape(-1, 90, 500)
+        B = h.shape[0]
+        h = h.reshape(B, C.WINDOW_COLS, cfg.gru_in_size)
+
+        if cfg.kind == "gru":
+            gru_params = jax.tree.map(lambda a: a.astype(dtype), params["gru"])
+            h = self.gru.apply(
+                gru_params,
+                h,
+                deterministic=deterministic,
+                rng=rngs[3] if train else None,
+            )
+        else:
+            from roko_tpu.models.transformer import transformer_apply
+
+            h = transformer_apply(
+                params["encoder"],
+                self.cfg,
+                h,
+                deterministic=deterministic,
+                rng=rngs[3] if train else None,
+            )
+
+        logits = _dense(params["head"], h.astype(jnp.float32))
+        return logits  # [B,90,num_classes] float32
+
+
+def build_model(cfg: Optional[ModelConfig] = None) -> RokoModel:
+    return RokoModel(cfg)
+
+
+def init_params(rng: jax.Array, cfg: Optional[ModelConfig] = None) -> Params:
+    return RokoModel(cfg).init(rng)

@@ -4,12 +4,24 @@ multi-chip sharding paths (dp/tp/sp) are exercised without TPU hardware
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# A sitecustomize hook may have imported jax and registered a TPU backend
+# before this file runs, in which case the env vars above are ignored —
+# force the platform through the live config instead (must happen before
+# the first jax.devices()/trace call). Only needed when jax is already
+# imported; a fresh import picks up JAX_PLATFORMS from the env.
+import sys
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 import random
 

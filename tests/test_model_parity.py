@@ -1,0 +1,98 @@
+"""Bit-level torch parity through the checkpoint converter (SURVEY.md §7
+step 4: gate order and the two-bias form are the hard part — these tests
+pin them). Separate module so a torch-less environment skips only parity,
+not the jax-only model tests."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+torch = pytest.importorskip("torch")
+import jax.numpy as jnp
+
+from roko_tpu import constants as C
+from roko_tpu.config import ModelConfig
+from roko_tpu.models import RokoModel
+from roko_tpu.models.convert import from_torch_state_dict
+
+
+
+
+def _torch_reference_model():
+    """The reference architecture rebuilt in torch (ref: roko/rnn_model.py:24-59)
+    to generate parity targets; random weights, eval mode."""
+    import torch.nn as nn
+
+    class Ref(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embedding = nn.Embedding(12, 50)
+            self.fc1 = nn.Linear(200, 100)
+            self.fc2 = nn.Linear(100, 10)
+            self.gru = nn.GRU(
+                500, 128, num_layers=3, batch_first=True,
+                bidirectional=True, dropout=0.2,
+            )
+            self.fc4 = nn.Linear(256, 5)
+
+        def forward(self, x):
+            x = self.embedding(x)
+            x = x.permute((0, 2, 3, 1))
+            x = torch.relu(self.fc1(x))
+            x = torch.relu(self.fc2(x))
+            x = x.reshape(-1, 90, 500)
+            x, _ = self.gru(x)
+            return self.fc4(x)
+
+    torch.manual_seed(1234)
+    m = Ref()
+    m.eval()
+    return m
+
+
+def _batch():
+    rng = np.random.default_rng(7)
+    return jnp.asarray(
+        rng.integers(0, C.FEATURE_VOCAB, size=(4, C.WINDOW_ROWS, C.WINDOW_COLS)),
+        dtype=jnp.int32,
+    )
+
+
+def test_torch_parity():
+    model, batch = RokoModel(ModelConfig()), _batch()
+    ref = _torch_reference_model()
+    with torch.no_grad():
+        want = ref(torch.from_numpy(np.asarray(batch)).long()).numpy()
+
+    params = from_torch_state_dict(ref.state_dict())
+    got = np.asarray(model.apply(params, batch))
+
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_torch_parity_gru_only():
+    """Isolate the recurrence: 1-layer bidir GRU vs torch on random input."""
+    from roko_tpu.models.gru import bidir_gru_stack
+
+    torch.manual_seed(99)
+    tg = torch.nn.GRU(16, 8, num_layers=2, batch_first=True, bidirectional=True)
+    tg.eval()
+    x = torch.randn(3, 11, 16)
+    with torch.no_grad():
+        want, _ = tg(x)
+
+    sd = tg.state_dict()
+    layers = []
+    for k in range(2):
+        layer = {}
+        for direction, suffix in (("fwd", ""), ("bwd", "_reverse")):
+            layer[direction] = {
+                "w_ih": np.asarray(sd[f"weight_ih_l{k}{suffix}"]).T,
+                "w_hh": np.asarray(sd[f"weight_hh_l{k}{suffix}"]).T,
+                "b_ih": np.asarray(sd[f"bias_ih_l{k}{suffix}"]),
+                "b_hh": np.asarray(sd[f"bias_hh_l{k}{suffix}"]),
+            }
+        layers.append(layer)
+
+    got = bidir_gru_stack(tuple(layers), jnp.asarray(x.numpy()))
+    np.testing.assert_allclose(np.asarray(got), want.numpy(), rtol=1e-5, atol=1e-5)
