@@ -1,0 +1,31 @@
+"""Device-mesh parallelism: mesh construction, sharding specs, and the
+collective patterns (data/tensor/sequence parallel) used by training and
+inference.
+
+The reference has no distributed backend at all (SURVEY.md §2 parallelism
+inventory); this package is the TPU-native runtime that replaces nothing
+and adds dp/tp/sp over a `jax.sharding.Mesh` with XLA collectives riding
+ICI.
+"""
+
+from roko_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_SP,
+    AXIS_TP,
+    data_sharding,
+    make_mesh,
+    mesh_shape,
+    replicated_sharding,
+    shard_batch,
+)
+
+__all__ = [
+    "AXIS_DP",
+    "AXIS_TP",
+    "AXIS_SP",
+    "make_mesh",
+    "mesh_shape",
+    "data_sharding",
+    "replicated_sharding",
+    "shard_batch",
+]

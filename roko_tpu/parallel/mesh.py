@@ -1,0 +1,80 @@
+"""Mesh construction and sharding helpers.
+
+Axis convention (MeshConfig, roko_tpu/config.py):
+
+- ``dp``  — data parallel: shards the window/batch axis. The workhorse:
+  roko's genome-scale decomposition is window-level (SURVEY.md §5.7), so
+  dp over windows *is* its sequence scaling.
+- ``tp``  — tensor parallel: shards hidden dims of the transformer
+  variant's matmuls.
+- ``sp``  — sequence parallel: shards the pileup-column (time) axis for
+  the transformer variant's ring attention.
+
+All specs are `PartitionSpec`s over these names; `jit` with
+`NamedSharding(in/out_shardings)` makes XLA insert the psum/all-gather
+collectives over ICI — there is no hand-written communication outside
+`roko_tpu/parallel/ring.py`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from roko_tpu.config import MeshConfig
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+
+
+def mesh_shape(
+    cfg: MeshConfig, n_devices: Optional[int] = None
+) -> tuple[int, int, int]:
+    """Resolve (dp, tp, sp) sizes; a -1 axis absorbs remaining devices."""
+    n = n_devices if n_devices is not None else len(jax.devices())
+    sizes = [cfg.dp, cfg.tp, cfg.sp]
+    n_free = sizes.count(-1)
+    if n_free > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    fixed = math.prod(s for s in sizes if s != -1)
+    if n_free:
+        if n % fixed:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes = [n // fixed if s == -1 else s for s in sizes]
+    elif fixed != n:
+        raise ValueError(f"mesh {sizes} wants {fixed} devices, have {n}")
+    return tuple(sizes)  # type: ignore[return-value]
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    cfg = cfg or MeshConfig()
+    devs = list(devices) if devices is not None else jax.devices()
+    dp, tp, sp = mesh_shape(cfg, len(devs))
+    arr = np.array(devs).reshape(dp, tp, sp)
+    return Mesh(arr, (AXIS_DP, AXIS_TP, AXIS_SP))
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Batch axis sharded over dp, everything else replicated."""
+    return NamedSharding(mesh, P(AXIS_DP))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch):
+    """Place a host batch (pytree of arrays, leading axis = batch) onto the
+    mesh sharded over dp. Batch size must divide by the dp extent."""
+    sharding = data_sharding(mesh)
+    return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)

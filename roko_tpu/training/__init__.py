@@ -1,0 +1,15 @@
+"""Training harness: explicit JAX train loop replacing the reference's
+pytorch-ignite engines (ref: roko/train.py).
+
+- `roko_tpu.training.data` — host-side batch pipeline (shuffle, batch,
+  double-buffered device prefetch).
+- `roko_tpu.training.loop` — jitted train/eval steps sharded over the
+  device mesh, epoch driver, early stopping.
+- `roko_tpu.training.checkpoint` — Orbax checkpoints carrying params,
+  optimizer state and step (the reference kept best-model params only,
+  SURVEY.md §5.4).
+"""
+
+from roko_tpu.training.loop import TrainState, train
+
+__all__ = ["train", "TrainState"]

@@ -1,0 +1,88 @@
+"""Orbax checkpointing.
+
+The reference checkpoints model params only, keyed by validation accuracy
+(ignite ModelCheckpoint, ref: roko/train.py:82-84) — no optimizer state,
+no resume. Here every checkpoint carries ``{params, opt_state, step}``
+plus the val-accuracy metric, the manager keeps the best-k by val_acc,
+and ``restore_latest``/``restore_best`` give both resume-from-step and
+best-model-for-inference (SURVEY.md §5.3-5.4 build notes).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                best_fn=lambda m: m["val_acc"],
+                best_mode="max",
+            ),
+        )
+
+    def save(self, step: int, state: Dict[str, Any], val_acc: float) -> None:
+        self._mgr.save(
+            step,
+            args=ocp.args.StandardSave(state),
+            metrics={"val_acc": float(val_acc)},
+        )
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def _restore(self, step: Optional[int], like: Optional[Dict[str, Any]]):
+        if step is None:
+            return None
+        if like is not None:
+            target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
+        return self._mgr.restore(step)
+
+    def restore_latest(self, like=None) -> Optional[Dict[str, Any]]:
+        return self._restore(self._mgr.latest_step(), like)
+
+    def restore_best(self, like=None) -> Optional[Dict[str, Any]]:
+        return self._restore(self._mgr.best_step(), like)
+
+    def best_step(self) -> Optional[int]:
+        return self._mgr.best_step()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def load_params(path: str) -> Dict[str, Any]:
+    """Load params from either a checkpoint directory (best step) or a
+    single saved-state dir; returns the params pytree."""
+    path = os.path.abspath(path)
+    if os.path.isdir(path) and any(
+        name.isdigit() for name in os.listdir(path)
+    ):
+        mgr = CheckpointManager(path)
+        try:
+            state = mgr.restore_best()
+        finally:
+            mgr.close()
+        if state is None:
+            raise FileNotFoundError(f"no checkpoints under {path}")
+        return state["params"]
+    ckptr = ocp.StandardCheckpointer()
+    state = ckptr.restore(path)
+    return state["params"] if "params" in state else state
+
+
+def save_params(path: str, params: Dict[str, Any]) -> None:
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), {"params": params})
+    ckptr.wait_until_finished()

@@ -1,0 +1,131 @@
+"""Host-side training data pipeline.
+
+Replaces torch `DataLoader(shuffle=True, num_workers=t)` (ref:
+roko/train.py:30-32): examples live in host RAM as one uint8 ndarray
+(the full Zymo 5-species train set is ~5 GB — comfortably host-resident),
+an epoch is a seeded permutation, and a background thread keeps
+`prefetch` batches ahead of the device so the TPU never waits on the
+host. No worker processes: the transfer is one `device_put` of an
+already-sliced contiguous array per batch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from roko_tpu.data.hdf5 import load_training_arrays
+
+
+class InMemoryDataset:
+    """Flat (X, Y) arrays in host RAM (ref: InMemoryTrainDataset,
+    roko/datasets.py:82-119)."""
+
+    def __init__(self, X: np.ndarray, Y: np.ndarray):
+        assert len(X) == len(Y)
+        self.X = np.ascontiguousarray(X, dtype=np.uint8)
+        self.Y = np.ascontiguousarray(Y, dtype=np.int32)
+
+    @staticmethod
+    def from_path(path: str) -> "InMemoryDataset":
+        X, Y = load_training_arrays(path)
+        return InMemoryDataset(X, Y)
+
+    def __len__(self) -> int:
+        return len(self.X)
+
+    def batches(
+        self,
+        batch_size: int,
+        *,
+        rng: Optional[np.random.Generator] = None,
+        drop_remainder: bool = False,
+        pad_to: Optional[int] = None,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Yield (x, y, weight) host batches.
+
+        ``weight`` is 1.0 for real rows, 0.0 for padding rows added to
+        reach ``pad_to`` (so sharded eval can use fixed batch shapes
+        without biasing metrics).
+        """
+        n = len(self)
+        order = rng.permutation(n) if rng is not None else np.arange(n)
+        for start in range(0, n, batch_size):
+            idx = order[start : start + batch_size]
+            if len(idx) < batch_size:
+                if drop_remainder:
+                    return
+                if pad_to is not None:
+                    x = self.X[idx]
+                    y = self.Y[idx]
+                    w = np.ones(len(idx), np.float32)
+                    pad = pad_to - len(idx)
+                    if pad > 0:
+                        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+                        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+                        w = np.concatenate([w, np.zeros(pad, np.float32)])
+                    yield x, y, w
+                    return
+            x = self.X[idx]
+            y = self.Y[idx]
+            yield x, y, np.ones(len(idx), np.float32)
+
+
+def prefetch_to_device(iterator, size: int, place) -> Iterator:
+    """Run ``place`` (host batch -> device arrays) in a producer thread,
+    keeping up to ``size`` batches in flight. JAX dispatch is async, so
+    overlapping the host slice + device_put of batch N+1 with compute of
+    batch N is all the pipelining the single-host case needs (the
+    reference used DataLoader worker processes for the same purpose,
+    roko/train.py:30)."""
+    if size <= 0:
+        for item in iterator:
+            yield place(item)
+        return
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    _END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up when the consumer is gone, so an
+        abandoned generator can't pin device batches forever."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in iterator:
+                if not _put(place(item)):
+                    return
+        except BaseException as e:  # surface errors on the consumer side
+            _put(e)
+            return
+        _put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while not q.empty():  # unblock the producer and drop its buffers
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

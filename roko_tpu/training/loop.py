@@ -1,0 +1,246 @@
+"""Jitted, mesh-sharded train/eval steps and the epoch driver.
+
+Replaces the reference's ignite Engine pair + callbacks (ref:
+roko/train.py:41-111) with an explicit loop: Adam(1e-4), cross-entropy
+over the 5 base classes at every one of the 90 window columns, per-epoch
+validation accuracy, early stopping with patience 7, best-k Orbax
+checkpoints (ref hyperparams: roko/train.py:12-15,39,74-84).
+
+TPU mapping: params and optimizer state are replicated over the mesh,
+batches are sharded over the ``dp`` axis (`PartitionSpec("dp")`), and the
+gradient all-reduce is the `psum` XLA inserts for the replicated-output
+jit — no hand-written collectives (SURVEY.md §2 north-star row "Data
+parallel (training)").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from roko_tpu.config import RokoConfig
+from roko_tpu.models.model import RokoModel
+from roko_tpu.parallel.mesh import (
+    AXIS_DP,
+    data_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from roko_tpu.training import checkpoint as ckpt_lib
+from roko_tpu.training.data import InMemoryDataset, prefetch_to_device
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Params
+    opt_state: Any
+    step: jax.Array  # scalar int32
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"params": self.params, "opt_state": self.opt_state, "step": self.step}
+
+
+def create_state(
+    model: RokoModel, tx: optax.GradientTransformation, rng: jax.Array
+) -> TrainState:
+    params = model.init(rng)
+    return TrainState(params, tx.init(params), jnp.zeros((), jnp.int32))
+
+
+def _loss_and_stats(model, params, x, y, w, rng):
+    """Mean CE over real rows + summed correct/total counts.
+
+    ``w`` is a per-row weight (0 for padding rows) so fixed-shape sharded
+    batches don't bias the metrics.
+    """
+    logits = model.apply(
+        params, x, deterministic=rng is None, rng=rng
+    )  # [B,90,5] f32
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    per_row = -ll.mean(axis=-1)  # [B] mean over 90 columns
+    denom = jnp.maximum(w.sum(), 1.0)
+    loss = (per_row * w).sum() / denom
+    pred = jnp.argmax(logits, axis=-1)
+    correct = ((pred == y) * w[:, None]).sum()
+    total = w.sum() * y.shape[1]
+    return loss, (correct, total)
+
+
+def make_train_step(
+    model: RokoModel, tx: optax.GradientTransformation, mesh: Mesh
+) -> Callable:
+    repl = replicated_sharding(mesh)
+    data = data_sharding(mesh)
+
+    @partial(
+        jax.jit,
+        in_shardings=(repl, repl, repl, data, data, data, repl),
+        out_shardings=(repl, repl, repl, repl),
+        donate_argnums=(0, 1),
+    )
+    def step(params, opt_state, step_no, x, y, w, rng):
+        rng = jax.random.fold_in(rng, step_no)
+
+        def loss_fn(p):
+            loss, aux = _loss_and_stats(model, p, x, y, w, rng)
+            return loss, aux
+
+        (loss, (correct, total)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, correct / jnp.maximum(total, 1.0)
+
+    return step
+
+
+def make_eval_step(model: RokoModel, mesh: Mesh) -> Callable:
+    repl = replicated_sharding(mesh)
+    data = data_sharding(mesh)
+
+    @partial(
+        jax.jit,
+        in_shardings=(repl, data, data, data),
+        out_shardings=(repl, repl, repl),
+    )
+    def step(params, x, y, w):
+        loss, (correct, total) = _loss_and_stats(model, params, x, y, w, None)
+        return loss, correct, total
+
+    return step
+
+
+def evaluate(eval_step, params, dataset, batch_size, mesh) -> Tuple[float, float]:
+    """Return (mean position accuracy, mean per-window loss)."""
+    sharding = data_sharding(mesh)
+
+    def place(batch):
+        x, y, w = batch
+        return tuple(jax.device_put(a, sharding) for a in (x, y, w))
+
+    correct = total = 0.0
+    loss_sum = rows = 0.0
+    it = dataset.batches(batch_size, pad_to=batch_size)
+    for x, y, w in prefetch_to_device(it, 2, place):
+        n_rows = float(w.sum())
+        loss, c, t = eval_step(params, x, y, w)
+        loss_sum += float(loss) * n_rows
+        rows += n_rows
+        correct += float(c)
+        total += float(t)
+    return correct / max(total, 1.0), loss_sum / max(rows, 1.0)
+
+
+def train(
+    cfg: RokoConfig,
+    train_path: str,
+    out_dir: str,
+    val_path: Optional[str] = None,
+    *,
+    mesh: Optional[Mesh] = None,
+    log: Callable[[str], None] = print,
+) -> TrainState:
+    """Full training run; returns the final state. Best-k checkpoints by
+    validation accuracy land in ``out_dir`` (ref flow: roko/train.py:18-111)."""
+    tcfg = cfg.train
+    mesh = mesh or make_mesh(cfg.mesh)
+    dp = mesh.shape[AXIS_DP]
+    if tcfg.batch_size % dp:
+        raise ValueError(
+            f"batch_size {tcfg.batch_size} not divisible by dp={dp}"
+        )
+
+    train_ds = InMemoryDataset.from_path(train_path)
+    val_ds = InMemoryDataset.from_path(val_path) if val_path else None
+    log(
+        f"train windows: {len(train_ds)}"
+        + (f", val windows: {len(val_ds)}" if val_ds else " (no val set)")
+    )
+
+    model = RokoModel(cfg.model)
+    tx = optax.adam(tcfg.lr)
+    root = jax.random.PRNGKey(tcfg.seed)
+    init_rng, dropout_rng = jax.random.split(root)
+    state = create_state(model, tx, init_rng)
+    repl = replicated_sharding(mesh)
+    state = TrainState(
+        jax.device_put(state.params, repl),
+        jax.device_put(state.opt_state, repl),
+        state.step,
+    )
+
+    train_step = make_train_step(model, tx, mesh)
+    eval_step = make_eval_step(model, mesh)
+    sharding = data_sharding(mesh)
+
+    def place(batch):
+        x, y, w = batch
+        return tuple(jax.device_put(a, sharding) for a in (x, y, w))
+
+    manager = ckpt_lib.CheckpointManager(out_dir, keep=tcfg.keep_checkpoints)
+    best_acc, bad_epochs = -1.0, 0
+    np_rng = np.random.default_rng(tcfg.seed)
+    params, opt_state, step_no = state.params, state.opt_state, state.step
+
+    try:
+        for epoch in range(tcfg.epochs):
+            t0 = time.perf_counter()
+            # pad the trailing batch (zero-weight rows) instead of dropping
+            # it: fixed shapes for XLA, but every window trains (the
+            # reference's DataLoader also kept the last partial batch)
+            batches = train_ds.batches(
+                tcfg.batch_size, rng=np_rng, pad_to=tcfg.batch_size
+            )
+            # loss accumulates on device; one host transfer per epoch so
+            # dispatch never blocks on a per-step float()
+            running = jnp.zeros((), jnp.float32)
+            n_batches = 0
+            for x, y, w in prefetch_to_device(batches, tcfg.prefetch, place):
+                params, opt_state, loss, _ = train_step(
+                    params, opt_state, step_no, x, y, w, dropout_rng
+                )
+                step_no = step_no + 1
+                running = running + loss
+                n_batches += 1
+            running = float(jax.device_get(running))
+            dt = time.perf_counter() - t0
+
+            eval_ds = val_ds if val_ds is not None else train_ds
+            acc, vloss = evaluate(eval_step, params, eval_ds, tcfg.batch_size, mesh)
+            log(
+                f"epoch {epoch}: train_loss {running / max(n_batches,1):.4f} "
+                f"val_acc {acc:.5f} val_loss {vloss:.4f} "
+                f"({dt:.1f}s, {n_batches} steps, "
+                f"{n_batches * tcfg.batch_size / max(dt, 1e-9):.0f} windows/s)"
+            )
+
+            manager.save(
+                int(jax.device_get(step_no)),
+                {"params": params, "opt_state": opt_state, "step": step_no},
+                acc,
+            )
+
+            # early stopping, patience on val accuracy (ref: roko/train.py:74-80)
+            if acc > best_acc:
+                best_acc, bad_epochs = acc, 0
+            else:
+                bad_epochs += 1
+                if bad_epochs >= tcfg.patience:
+                    log(f"early stop at epoch {epoch} (best val_acc {best_acc:.5f})")
+                    break
+    finally:
+        manager.close()
+
+    return TrainState(params, opt_state, step_no)

@@ -1,0 +1,133 @@
+"""Inference + stitcher tests, including the reference's documented edge
+behaviors (SURVEY.md §3.4): GAP skip, leading-insertion drop, and
+zero-coverage omission."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig
+from roko_tpu.data.hdf5 import DataWriter
+from roko_tpu.infer import VoteBoard, make_predict_step, run_inference
+from roko_tpu.models.model import RokoModel
+from roko_tpu.parallel.mesh import make_mesh
+
+A, Cc, G, T, GAP = range(5)
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+def _vote(board, contig, triples):
+    """triples: list of (pos, ins, base_class) single votes."""
+    n = len(triples)
+    positions = np.zeros((1, n, 2), np.int64)
+    preds = np.zeros((1, n), np.int32)
+    for i, (pos, ins, base) in enumerate(triples):
+        positions[0, i] = (pos, ins)
+        preds[0, i] = base
+    board.add([contig], positions, preds)
+
+
+def test_stitch_simple_replacement():
+    draft = "AAAAAAAAAA"
+    b = VoteBoard({"c": draft})
+    _vote(b, "c", [(2, 0, Cc), (3, 0, G), (4, 0, T)])
+    assert b.stitch("c") == "AA" + "CGT" + draft[5:]
+
+
+def test_stitch_gap_skipped_shortens():
+    draft = "AAAAAAAAAA"
+    b = VoteBoard({"c": draft})
+    _vote(b, "c", [(2, 0, Cc), (3, 0, GAP), (4, 0, T)])
+    assert b.stitch("c") == "AA" + "CT" + draft[5:]
+
+
+def test_stitch_insertion_slot_inserts():
+    draft = "AAAAAAAAAA"
+    b = VoteBoard({"c": draft})
+    _vote(b, "c", [(2, 0, Cc), (2, 1, G), (3, 0, T)])
+    assert b.stitch("c") == "AA" + "CGT" + draft[4:]
+
+
+def test_stitch_leading_insertion_dropped():
+    draft = "AAAAAAAAAA"
+    b = VoteBoard({"c": draft})
+    # window starts on an insertion slot: (2,1) must be dropped
+    _vote(b, "c", [(2, 1, G), (3, 0, T), (4, 0, Cc)])
+    assert b.stitch("c") == "AAA" + "TC" + draft[5:]
+
+
+def test_stitch_zero_coverage_omitted():
+    """Positions with no votes inside the span vanish from the output
+    (ref: roko/inference.py:140-144 iterates predicted positions only)."""
+    draft = "AAAAAAAAAA"
+    b = VoteBoard({"c": draft})
+    _vote(b, "c", [(2, 0, Cc), (6, 0, T)])  # 3,4,5 uncovered
+    assert b.stitch("c") == "AA" + "CT" + draft[7:]
+
+
+def test_stitch_majority_vote():
+    draft = "AAAA"
+    b = VoteBoard({"c": draft})
+    _vote(b, "c", [(1, 0, G)])
+    _vote(b, "c", [(1, 0, T)])
+    _vote(b, "c", [(1, 0, T)])
+    assert b.stitch("c") == "A" + "T" + draft[2:]
+
+
+def test_stitch_no_votes_returns_draft():
+    b = VoteBoard({"c": "ACGT"}, )
+    assert b.stitch("c") == "ACGT"
+
+
+def test_stitch_all_insertion_slots_returns_draft():
+    b = VoteBoard({"c": "ACGT"})
+    _vote(b, "c", [(1, 1, G), (2, 2, T)])
+    assert b.stitch("c") == "ACGT"
+
+
+def test_run_inference_end_to_end(rng, tmp_path):
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    n, B, W = 7, 200, 90
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, B, W)).astype(np.uint8)
+    positions = []
+    for i in range(n):
+        start = i * C.WINDOW_STRIDE
+        pos = np.stack(
+            [np.arange(start, start + W), np.zeros(W, np.int64)], axis=1
+        )
+        positions.append(pos)
+
+    path = tmp_path / "infer.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", positions, list(X), None)
+
+    cfg = RokoConfig(model=TINY, mesh=MeshConfig(dp=8))
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    logs = []
+    polished = run_inference(
+        str(path), params, cfg, batch_size=8, log=logs.append
+    )
+    assert set(polished) == {"ctg"}
+    out = polished["ctg"]
+    # span = positions 0..(6*30+89); untouched tail must be preserved
+    last = 6 * C.WINDOW_STRIDE + W - 1
+    assert out.endswith(draft[last + 1 :])
+    # every emitted base is a real base (no gaps/unknown)
+    assert set(out) <= set("ACGT")
+    assert any("windows/s" in l for l in logs)
+
+
+def test_predict_step_batch_invariance(rng):
+    """Same windows, different batch padding -> same predictions."""
+    model = RokoModel(TINY)
+    params = model.init(jax.random.PRNGKey(1))
+    mesh = make_mesh(MeshConfig(dp=8))
+    step = make_predict_step(model, mesh)
+    x = rng.integers(0, C.FEATURE_VOCAB, (8, 200, 90)).astype(np.uint8)
+    full = np.asarray(jax.device_get(step(params, x)))
+    padded = np.concatenate([x[:4], np.zeros((4, 200, 90), np.uint8)])
+    half = np.asarray(jax.device_get(step(params, padded)))[:4]
+    np.testing.assert_array_equal(full[:4], half)
