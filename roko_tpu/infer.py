@@ -16,7 +16,7 @@ each contig:
   exactly (SURVEY.md §3.4 note).
 
 TPU-first divergences from the reference implementation (not semantics):
-votes accumulate in flat per-contig uint32 arrays via ``np.add.at``
+votes accumulate in flat per-contig uint16 arrays via ``np.add.at``
 instead of nested ``defaultdict(Counter)`` — orders of magnitude faster
 at genome scale — and majority ties resolve to the lowest class index
 (deterministic) where ``Counter.most_common`` ties resolve to
@@ -56,10 +56,9 @@ _SLOTS = C.MAX_INS + 1  # ins 0..3
 def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
     """jit'd forward + argmax: uint8[B,200,90] -> int32[B,90] class ids.
     Batch sharded over dp; the argmax output gathers back replicated."""
-    repl = replicated_sharding(mesh)
     data = data_sharding(mesh)
 
-    @partial(jax.jit, in_shardings=(repl, data), out_shardings=data)
+    @partial(jax.jit, in_shardings=(None, data), out_shardings=data)
     def step(params, x):
         logits = model.apply(params, x, deterministic=True)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,52 @@
+"""Shared primitive layers: torch-parity Linear init, inverted dropout,
+layernorm. One copy, consumed by both model families, so checkpoint
+conversion parity (torch nn.Linear's U(-1/sqrt(in), 1/sqrt(in)) init and
+torch dropout scaling) is defined in exactly one place."""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_params(rng, in_dim: int, out_dim: int, dtype=jnp.float32) -> Dict:
+    kkernel, kbias = jax.random.split(rng)
+    # torch nn.Linear default: U(-1/sqrt(in), 1/sqrt(in)) for both
+    bound = 1.0 / math.sqrt(in_dim)
+    return {
+        "kernel": jax.random.uniform(
+            kkernel, (in_dim, out_dim), dtype, -bound, bound
+        ),
+        "bias": jax.random.uniform(kbias, (out_dim,), dtype, -bound, bound),
+    }
+
+
+def dense(p: Dict, x: jax.Array) -> jax.Array:
+    return x @ p["kernel"] + p["bias"]
+
+
+def dropout(rng, x: jax.Array, rate: float) -> jax.Array:
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def layernorm(p: Dict, x: jax.Array, eps: float = 1e-5) -> jax.Array:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = x.var(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def layernorm_params(dim: int, dtype=jnp.float32) -> Dict:
+    return {"scale": jnp.ones((dim,), dtype), "bias": jnp.zeros((dim,), dtype)}
+
+
+def cast_tree(tree, dtype):
+    """Cast every float leaf to ``dtype`` (int leaves untouched)."""
+    return jax.tree.map(
+        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        tree,
+    )

@@ -32,30 +32,14 @@ import jax.numpy as jnp
 from roko_tpu import constants as C
 from roko_tpu.config import ModelConfig
 from roko_tpu.models.gru import RokoGRU
+from roko_tpu.models.layers import (
+    cast_tree,
+    dense as _dense,
+    dense_params as _dense_params,
+    dropout as _dropout,
+)
 
 Params = Dict[str, Any]
-
-
-def _dense_params(rng, in_dim, out_dim, dtype=jnp.float32):
-    kkernel, kbias = jax.random.split(rng)
-    # torch nn.Linear default: U(-1/sqrt(in), 1/sqrt(in)) for both
-    bound = 1.0 / jnp.sqrt(in_dim)
-    return {
-        "kernel": jax.random.uniform(
-            kkernel, (in_dim, out_dim), dtype, -bound, bound
-        ),
-        "bias": jax.random.uniform(kbias, (out_dim,), dtype, -bound, bound),
-    }
-
-
-def _dense(p, x):
-    return x @ p["kernel"] + p["bias"]
-
-
-def _dropout(rng, x, rate):
-    keep = 1.0 - rate
-    mask = jax.random.bernoulli(rng, keep, x.shape)
-    return jnp.where(mask, x / keep, 0.0)
 
 
 class RokoModel:
@@ -124,10 +108,10 @@ class RokoModel:
         # read axis (200) to the back: [B,90,50,200]
         e = e.transpose(0, 2, 3, 1)
 
-        h = jax.nn.relu(_dense(jax.tree.map(lambda a: a.astype(dtype), params["fc1"]), e))
+        h = jax.nn.relu(_dense(cast_tree(params["fc1"], dtype), e))
         if train:
             h = _dropout(rngs[1], h, cfg.dropout)
-        h = jax.nn.relu(_dense(jax.tree.map(lambda a: a.astype(dtype), params["fc2"]), h))
+        h = jax.nn.relu(_dense(cast_tree(params["fc2"], dtype), h))
         if train:
             h = _dropout(rngs[2], h, cfg.dropout)
 
@@ -137,9 +121,8 @@ class RokoModel:
         h = h.reshape(B, C.WINDOW_COLS, cfg.gru_in_size)
 
         if cfg.kind == "gru":
-            gru_params = jax.tree.map(lambda a: a.astype(dtype), params["gru"])
             h = self.gru.apply(
-                gru_params,
+                cast_tree(params["gru"], dtype),
                 h,
                 deterministic=deterministic,
                 rng=rngs[3] if train else None,
@@ -148,7 +131,7 @@ class RokoModel:
             from roko_tpu.models.transformer import transformer_apply
 
             h = transformer_apply(
-                params["encoder"],
+                cast_tree(params["encoder"], dtype),
                 self.cfg,
                 h,
                 deterministic=deterministic,

@@ -1,0 +1,146 @@
+"""Transformer-encoder drop-in for the GRU recurrence.
+
+The consensus network's sequence axis is the 90 pileup columns
+(SURVEY.md §3.5); this variant replaces the 3-layer bidirectional GRU
+with a pre-LN transformer encoder over that axis (BASELINE.md
+"Transformer variant" row). Same contract as `RokoGRU.apply`:
+``[B, T, gru_in_size] -> [B, T, 2*hidden_size]`` so the classification
+head is shared between the two families.
+
+TPU mapping: attention and MLP are batched matmuls on the MXU; the head
+dim stays a multiple of 128. Tensor parallelism shards the head/MLP
+hidden axes (see `roko_tpu/parallel/tp.py` sharding rules); sequence
+parallelism for long-context variants runs this same attention body
+under `shard_map` with ring K/V rotation (`roko_tpu/parallel/ring.py`).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from roko_tpu.config import ModelConfig
+from roko_tpu.models.layers import (
+    dense as _dense,
+    dense_params as _dense_init,
+    dropout as _dropout,
+    layernorm as _layernorm,
+    layernorm_params as _ln_init,
+)
+
+Params = Dict[str, Any]
+
+
+def attention(q, k, v, num_heads: int):
+    """Dense bidirectional multi-head attention.
+
+    q,k,v: [B, T, D]. Exposed standalone so the ring-attention path can
+    reuse the identical per-block math (`roko_tpu/parallel/ring.py`).
+    """
+    B, T, D = q.shape
+    H = num_heads
+    hd = D // H
+    q = q.reshape(B, T, H, hd).transpose(0, 2, 1, 3)  # [B,H,T,hd]
+    k = k.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, T, H, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(hd)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return out.transpose(0, 2, 1, 3).reshape(B, T, D)
+
+
+def _layer_init(rng, d_model: int, mlp_dim: int, dtype=jnp.float32) -> Params:
+    keys = jax.random.split(rng, 6)
+    return {
+        "ln1": _ln_init(d_model, dtype),
+        "qkv": _dense_init(keys[0], d_model, 3 * d_model, dtype),
+        "proj": _dense_init(keys[1], d_model, d_model, dtype),
+        "ln2": _ln_init(d_model, dtype),
+        "mlp_in": _dense_init(keys[2], d_model, mlp_dim, dtype),
+        "mlp_out": _dense_init(keys[3], mlp_dim, d_model, dtype),
+    }
+
+
+def encoder_layer(
+    p: Params,
+    x: jax.Array,
+    num_heads: int,
+    *,
+    dropout: float = 0.0,
+    deterministic: bool = True,
+    rng: Optional[jax.Array] = None,
+    attn_fn=attention,
+) -> jax.Array:
+    """Pre-LN encoder block: x + MHA(LN(x)); x + MLP(LN(x))."""
+    h = _layernorm(p["ln1"], x)
+    qkv = _dense(p["qkv"], h)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    a = attn_fn(q, k, v, num_heads)
+    a = _dense(p["proj"], a)
+    if not deterministic:
+        rng, sub = jax.random.split(rng)
+        a = _dropout(sub, a, dropout)
+    x = x + a
+
+    h = _layernorm(p["ln2"], x)
+    h = _dense(p["mlp_out"], jax.nn.gelu(_dense(p["mlp_in"], h)))
+    if not deterministic:
+        rng, sub = jax.random.split(rng)
+        h = _dropout(sub, h, dropout)
+    return x + h
+
+
+def transformer_init(rng: jax.Array, cfg: ModelConfig) -> Params:
+    d = cfg.d_model
+    if d != 2 * cfg.hidden_size:
+        raise ValueError(
+            f"d_model ({d}) must equal 2*hidden_size ({2 * cfg.hidden_size}) "
+            "so the classification head is shared with the GRU family"
+        )
+    if d % cfg.num_heads:
+        raise ValueError(f"d_model {d} not divisible by {cfg.num_heads} heads")
+    keys = jax.random.split(rng, cfg.num_layers + 3)
+    from roko_tpu import constants as C
+
+    return {
+        "in_proj": _dense_init(keys[0], cfg.gru_in_size, d),
+        # learned positional embedding over the pileup-column axis
+        "pos_embed": 0.02
+        * jax.random.normal(keys[1], (C.WINDOW_COLS, d), jnp.float32),
+        "layers": tuple(
+            _layer_init(keys[2 + i], d, cfg.mlp_ratio * d)
+            for i in range(cfg.num_layers)
+        ),
+        "ln_out": _ln_init(d),
+    }
+
+
+def transformer_apply(
+    params: Params,
+    cfg: ModelConfig,
+    x: jax.Array,  # [B, T, gru_in_size]
+    *,
+    deterministic: bool = True,
+    rng: Optional[jax.Array] = None,
+    attn_fn=attention,
+) -> jax.Array:
+    h = _dense(params["in_proj"], x)
+    T = h.shape[1]
+    h = h + params["pos_embed"][:T].astype(h.dtype)
+    for i, layer in enumerate(params["layers"]):
+        sub = None
+        if not deterministic:
+            rng, sub = jax.random.split(rng)
+        h = encoder_layer(
+            layer,
+            h,
+            cfg.num_heads,
+            dropout=cfg.dropout,
+            deterministic=deterministic,
+            rng=sub,
+            attn_fn=attn_fn,
+        )
+    return _layernorm(params["ln_out"], h)  # [B, T, d_model]

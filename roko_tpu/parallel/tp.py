@@ -1,0 +1,67 @@
+"""Tensor-parallel parameter sharding rules.
+
+The GRU consensus model (1.1 M params) needs no tensor parallelism —
+params replicate and the batch shards over ``dp`` (SURVEY.md §2
+"Tensor parallel" row). The transformer variant's matmuls do shard: the
+classic Megatron split — column-parallel into the attention/MLP hidden,
+row-parallel back out — expressed purely as `PartitionSpec`s; XLA
+inserts the all-reduces over ICI when the jitted step consumes them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from roko_tpu.config import ModelConfig
+from roko_tpu.parallel.mesh import AXIS_TP
+
+
+def _repl(tree):
+    return jax.tree.map(lambda _: P(), tree)
+
+
+def _layer_specs() -> Dict[str, Any]:
+    return {
+        "ln1": {"scale": P(), "bias": P()},
+        # column-parallel: qkv hidden axis over tp (head-dim split)
+        "qkv": {"kernel": P(None, AXIS_TP), "bias": P(AXIS_TP)},
+        # row-parallel back to d_model; XLA all-reduces the partial sums
+        "proj": {"kernel": P(AXIS_TP, None), "bias": P()},
+        "ln2": {"scale": P(), "bias": P()},
+        "mlp_in": {"kernel": P(None, AXIS_TP), "bias": P(AXIS_TP)},
+        "mlp_out": {"kernel": P(AXIS_TP, None), "bias": P()},
+    }
+
+
+def param_specs(cfg: ModelConfig, params: Dict[str, Any]) -> Dict[str, Any]:
+    """PartitionSpec pytree matching ``params`` from ``RokoModel.init``."""
+    specs = {
+        "embedding": P(),
+        "fc1": {"kernel": P(), "bias": P()},
+        "fc2": {"kernel": P(), "bias": P()},
+        "head": {"kernel": P(), "bias": P()},
+    }
+    if cfg.kind == "gru":
+        specs["gru"] = _repl(params["gru"])
+    else:
+        n_layers = len(params["encoder"]["layers"])
+        specs["encoder"] = {
+            "in_proj": {"kernel": P(), "bias": P()},
+            "pos_embed": P(),
+            "layers": tuple(_layer_specs() for _ in range(n_layers)),
+            "ln_out": {"scale": P(), "bias": P()},
+        }
+    return specs
+
+
+def param_sharding(
+    cfg: ModelConfig, params: Dict[str, Any], mesh: Mesh
+) -> Dict[str, Any]:
+    return jax.tree.map(
+        lambda spec: NamedSharding(mesh, spec),
+        param_specs(cfg, params),
+        is_leaf=lambda x: isinstance(x, P),
+    )

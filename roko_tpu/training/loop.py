@@ -83,10 +83,14 @@ def make_train_step(
     repl = replicated_sharding(mesh)
     data = data_sharding(mesh)
 
+    # params/opt_state shardings are None: the step preserves whatever
+    # placement the caller chose (replicated for the GRU family,
+    # tensor-parallel NamedShardings from parallel/tp.py for the
+    # transformer), so the same step function serves dp and dp+tp.
     @partial(
         jax.jit,
-        in_shardings=(repl, repl, repl, data, data, data, repl),
-        out_shardings=(repl, repl, repl, repl),
+        in_shardings=(None, None, repl, data, data, data, repl),
+        out_shardings=(None, None, repl, repl),
         donate_argnums=(0, 1),
     )
     def step(params, opt_state, step_no, x, y, w, rng):
@@ -112,7 +116,7 @@ def make_eval_step(model: RokoModel, mesh: Mesh) -> Callable:
 
     @partial(
         jax.jit,
-        in_shardings=(repl, data, data, data),
+        in_shardings=(None, data, data, data),
         out_shardings=(repl, repl, repl),
     )
     def step(params, x, y, w):
