@@ -1,0 +1,121 @@
+"""Benchmark: polished-bases/sec/chip for flagship-model inference.
+
+Measures the jitted forward+argmax path (the device-side hot loop of
+`roko_tpu/infer.py`) on whatever accelerator JAX sees — the TPU chip in
+the driver run. `vs_baseline` compares against the reference
+architecture executed in torch on CPU (BASELINE.json configs[0] is a
+"CPU reference run"; the reference publishes no throughput numbers at
+all, SURVEY.md §6), timed here on an identically-shaped model.
+
+Each window advances the genome by WINDOW_STRIDE=30 columns, so
+bases/sec = windows/sec x 30 (SURVEY.md §5.7 window decomposition).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 128
+WARMUP = 3
+ITERS = 20
+TORCH_ITERS = 3
+
+
+def bench_jax() -> float:
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import ModelConfig
+    from roko_tpu.models.model import RokoModel
+
+    model = RokoModel(ModelConfig(compute_dtype="bfloat16"))
+    params = model.init(jax.random.PRNGKey(0))
+
+    @jax.jit
+    def predict(params, x):
+        return jax.numpy.argmax(
+            model.apply(params, x, deterministic=True), axis=-1
+        )
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(
+        0, C.FEATURE_VOCAB, (BATCH, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    x = jax.device_put(x)
+
+    # sync via an actual device->host fetch: on the tunneled TPU platform
+    # block_until_ready returns at dispatch, not compute completion, so a
+    # block_until_ready-based timer reads ~1000x too fast
+    for _ in range(WARMUP):
+        np.asarray(predict(params, x))
+    t0 = time.perf_counter()
+    outs = [predict(params, x) for _ in range(ITERS)]
+    np.asarray(outs[-1])
+    dt = time.perf_counter() - t0
+    return BATCH * ITERS / dt  # windows/sec
+
+
+def bench_torch_reference() -> float:
+    """The reference's architecture (roko/rnn_model.py:24-59 semantics) in
+    torch on CPU — the only hardware the reference runs on in this image."""
+    import torch
+
+    class RefModel(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.embedding = torch.nn.Embedding(12, 50)
+            self.fc1 = torch.nn.Linear(200, 100)
+            self.fc2 = torch.nn.Linear(100, 10)
+            self.gru = torch.nn.GRU(
+                500, 128, 3, batch_first=True, bidirectional=True, dropout=0.2
+            )
+            self.head = torch.nn.Linear(256, 5)
+
+        def forward(self, x):
+            e = self.embedding(x)  # [B,200,90,50]
+            e = e.permute(0, 2, 3, 1)  # [B,90,50,200]
+            h = torch.relu(self.fc1(e))
+            h = torch.relu(self.fc2(h))  # [B,90,50,10]
+            h = h.reshape(-1, 90, 500)
+            h, _ = self.gru(h)
+            return self.head(h)
+
+    model = RefModel().eval()
+    x = torch.randint(0, 12, (BATCH, 200, 90))
+    with torch.no_grad():
+        model(x)  # warmup
+        t0 = time.perf_counter()
+        for _ in range(TORCH_ITERS):
+            out = model(x)
+        dt = time.perf_counter() - t0
+    del out
+    return BATCH * TORCH_ITERS / dt  # windows/sec
+
+
+def main() -> None:
+    from roko_tpu import constants as C
+
+    windows_per_sec = bench_jax()
+    ref_windows_per_sec = bench_torch_reference()
+    bases_per_sec = windows_per_sec * C.WINDOW_STRIDE
+    print(
+        json.dumps(
+            {
+                "metric": "polished_bases_per_sec_per_chip",
+                "value": round(bases_per_sec, 1),
+                "unit": "bases/s",
+                "vs_baseline": round(
+                    windows_per_sec / ref_windows_per_sec, 2
+                ),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

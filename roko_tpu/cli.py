@@ -1,0 +1,184 @@
+"""Command-line interface: the reference's three CLI stages under one
+entry point (``python -m roko_tpu <stage>`` or the ``roko-tpu`` console
+script).
+
+Stage flags mirror the reference argparse surfaces —
+``features`` (ref: roko/features.py:113-121), ``train``
+(ref: roko/train.py:115-125), ``inference``
+(ref: roko/inference.py:157-166) — plus TPU-native extras (mesh axes,
+model family, checkpoint/convert helpers) that have no reference
+counterpart.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def _mesh_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--dp", type=int, default=-1, help="data-parallel mesh axis (-1 = all devices)")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
+    p.add_argument("--sp", type=int, default=1, help="sequence-parallel mesh axis")
+
+
+def _model_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--model-kind", choices=("gru", "transformer"), default="gru")
+    p.add_argument("--hidden-size", type=int, default=128)
+    p.add_argument("--num-layers", type=int, default=3)
+    p.add_argument("--compute-dtype", default="float32", choices=("float32", "bfloat16"))
+    p.add_argument("--use-pallas", action="store_true", help="fused Pallas GRU kernel on TPU")
+
+
+def _build_config(args: argparse.Namespace):
+    from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig, TrainConfig
+
+    model = ModelConfig(
+        kind=getattr(args, "model_kind", "gru"),
+        hidden_size=getattr(args, "hidden_size", 128),
+        num_layers=getattr(args, "num_layers", 3),
+        compute_dtype=getattr(args, "compute_dtype", "float32"),
+        use_pallas=getattr(args, "use_pallas", False),
+        d_model=2 * getattr(args, "hidden_size", 128),
+    )
+    train = TrainConfig(
+        batch_size=getattr(args, "b", 128),
+        epochs=getattr(args, "epochs", 100),
+        lr=getattr(args, "lr", 1e-4),
+        patience=getattr(args, "patience", 7),
+        seed=getattr(args, "seed", 0),
+        in_memory=getattr(args, "memory", True),
+    )
+    mesh = MeshConfig(
+        dp=getattr(args, "dp", -1),
+        tp=getattr(args, "tp", 1),
+        sp=getattr(args, "sp", 1),
+    )
+    return RokoConfig(model=model, train=train, mesh=mesh)
+
+
+def cmd_features(args: argparse.Namespace) -> int:
+    from roko_tpu.features.pipeline import run_features
+
+    n = run_features(
+        args.ref,
+        args.X,
+        args.o,
+        bam_y=args.Y,
+        workers=args.t,
+        seed=args.seed,
+    )
+    print(f"wrote {n} windows to {args.o}")
+    return 0
+
+
+def cmd_train(args: argparse.Namespace) -> int:
+    from roko_tpu.training.loop import train
+
+    cfg = _build_config(args)
+    train(cfg, args.train, args.out, val_path=args.val)
+    return 0
+
+
+def cmd_inference(args: argparse.Namespace) -> int:
+    from roko_tpu.infer import polish_to_fasta
+    from roko_tpu.training.checkpoint import load_params
+
+    cfg = _build_config(args)
+    if args.model.endswith(".pth"):
+        from roko_tpu.models.convert import load_torch_checkpoint
+
+        params = load_torch_checkpoint(args.model, cfg.model)
+    else:
+        params = load_params(args.model)
+    polish_to_fasta(args.data, params, args.out, cfg, batch_size=args.b)
+    print(f"wrote polished contigs to {args.out}")
+    return 0
+
+
+def cmd_convert(args: argparse.Namespace) -> int:
+    """One-shot torch -> native checkpoint conversion (ref checkpoint
+    r10_2.3.8.pth, SURVEY.md §5.4 build note)."""
+    from roko_tpu.models.convert import load_torch_checkpoint
+    from roko_tpu.training.checkpoint import save_params
+
+    cfg = _build_config(args)
+    params = load_torch_checkpoint(args.torch_ckpt, cfg.model)
+    save_params(args.out, params)
+    print(f"converted {args.torch_ckpt} -> {args.out}")
+    return 0
+
+
+def cmd_bench(args: argparse.Namespace) -> int:
+    from roko_tpu.benchmark import main as bench_main
+
+    bench_main()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="roko-tpu", description="TPU-native genome assembly polisher"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("features", help="FASTA + BAM -> features HDF5")
+    p.add_argument("ref", help="draft assembly FASTA")
+    p.add_argument("X", help="reads-to-draft BAM")
+    p.add_argument("o", help="output HDF5 path")
+    p.add_argument("--Y", default=None, help="truth-to-draft BAM (training mode)")
+    p.add_argument("--t", type=int, default=1, help="worker processes")
+    p.add_argument("--seed", type=int, default=0, help="row-sampling RNG seed")
+    p.set_defaults(fn=cmd_features)
+
+    p = sub.add_parser("train", help="features HDF5 -> checkpoints")
+    p.add_argument("train", help="training HDF5 file or directory")
+    p.add_argument("out", help="checkpoint output directory")
+    p.add_argument("--val", default=None, help="validation HDF5 file or directory")
+    p.add_argument("--b", type=int, default=128, help="global batch size")
+    p.add_argument("--epochs", type=int, default=100)
+    p.add_argument("--lr", type=float, default=1e-4)
+    p.add_argument("--patience", type=int, default=7)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument(
+        "--memory",
+        action="store_true",
+        default=True,
+        help="keep dataset in host RAM (ref --memory; always on here)",
+    )
+    _model_args(p)
+    _mesh_args(p)
+    p.set_defaults(fn=cmd_train)
+
+    p = sub.add_parser("inference", help="features HDF5 + checkpoint -> polished FASTA")
+    p.add_argument("data", help="inference HDF5")
+    p.add_argument("model", help="checkpoint dir, saved params, or torch .pth")
+    p.add_argument("out", help="output FASTA path")
+    p.add_argument("--b", type=int, default=128, help="batch size")
+    p.add_argument(
+        "--t", type=int, default=0, help="accepted for reference parity (unused)"
+    )
+    _model_args(p)
+    _mesh_args(p)
+    p.set_defaults(fn=cmd_inference)
+
+    p = sub.add_parser("convert", help="torch .pth -> native checkpoint")
+    p.add_argument("torch_ckpt")
+    p.add_argument("out")
+    _model_args(p)
+    p.set_defaults(fn=cmd_convert)
+
+    p = sub.add_parser("bench", help="print the benchmark JSON line")
+    p.set_defaults(fn=cmd_bench)
+
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

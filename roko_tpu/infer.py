@@ -55,7 +55,7 @@ _SLOTS = C.MAX_INS + 1  # ins 0..3
 
 def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
     """jit'd forward + argmax: uint8[B,200,90] -> int32[B,90] class ids.
-    Batch sharded over dp; the argmax output gathers back replicated."""
+    Batch and output both sharded over dp; the host fetch concatenates."""
     data = data_sharding(mesh)
 
     @partial(jax.jit, in_shardings=(None, data), out_shardings=data)

@@ -56,11 +56,16 @@ def _layer_init(rng, d_model: int, mlp_dim: int, dtype=jnp.float32) -> Params:
     keys = jax.random.split(rng, 6)
     return {
         "ln1": _ln_init(d_model, dtype),
-        "qkv": _dense_init(keys[0], d_model, 3 * d_model, dtype),
-        "proj": _dense_init(keys[1], d_model, d_model, dtype),
+        # q/k/v kept as separate projections so tensor-parallel sharding
+        # of each output axis is head-aligned (a fused [d,3d] kernel would
+        # put tp shard boundaries inside k and force activation reshards)
+        "q": _dense_init(keys[0], d_model, d_model, dtype),
+        "k": _dense_init(keys[1], d_model, d_model, dtype),
+        "v": _dense_init(keys[2], d_model, d_model, dtype),
+        "proj": _dense_init(keys[3], d_model, d_model, dtype),
         "ln2": _ln_init(d_model, dtype),
-        "mlp_in": _dense_init(keys[2], d_model, mlp_dim, dtype),
-        "mlp_out": _dense_init(keys[3], mlp_dim, d_model, dtype),
+        "mlp_in": _dense_init(keys[4], d_model, mlp_dim, dtype),
+        "mlp_out": _dense_init(keys[5], mlp_dim, d_model, dtype),
     }
 
 
@@ -76,8 +81,9 @@ def encoder_layer(
 ) -> jax.Array:
     """Pre-LN encoder block: x + MHA(LN(x)); x + MLP(LN(x))."""
     h = _layernorm(p["ln1"], x)
-    qkv = _dense(p["qkv"], h)
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = _dense(p["q"], h)
+    k = _dense(p["k"], h)
+    v = _dense(p["v"], h)
     a = attn_fn(q, k, v, num_heads)
     a = _dense(p["proj"], a)
     if not deterministic:

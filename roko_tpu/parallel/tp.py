@@ -24,10 +24,13 @@ def _repl(tree):
 
 
 def _layer_specs() -> Dict[str, Any]:
+    qkv_spec = {"kernel": P(None, AXIS_TP), "bias": P(AXIS_TP)}
     return {
         "ln1": {"scale": P(), "bias": P()},
-        # column-parallel: qkv hidden axis over tp (head-dim split)
-        "qkv": {"kernel": P(None, AXIS_TP), "bias": P(AXIS_TP)},
+        # column-parallel: each of q/k/v shards its output (head) axis
+        "q": dict(qkv_spec),
+        "k": dict(qkv_spec),
+        "v": dict(qkv_spec),
         # row-parallel back to d_model; XLA all-reduces the partial sums
         "proj": {"kernel": P(AXIS_TP, None), "bias": P()},
         "ln2": {"scale": P(), "bias": P()},

@@ -1,0 +1,73 @@
+"""CLI smoke tests: the three reference stages end-to-end through the
+argparse surface (SURVEY.md §2.4/§2.10/§2.11 flag parity)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tests.helpers import (
+    cigar_from_string,
+    make_record,
+    random_seq,
+    simulate_reads,
+)
+from roko_tpu.cli import build_parser, main
+from roko_tpu.io.bam import write_sorted_bam
+from roko_tpu.io.fasta import read_fasta, write_fasta
+
+
+@pytest.fixture(scope="module")
+def tiny_project(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cli")
+    rng = random.Random(3)
+    draft = random_seq(rng, 4000)
+    write_fasta(str(root / "draft.fasta"), [("ctg", draft)])
+    reads = simulate_reads(rng, draft, 0, coverage=20)
+    write_sorted_bam(str(root / "reads.bam"), [("ctg", len(draft))], reads)
+    # truth-to-draft: one full-length alignment (truth == draft); the
+    # labeler's overlap filter would drop mutually-overlapping records
+    truth = make_record("truth", 0, 0, draft, cigar_from_string(f"{len(draft)}M"))
+    write_sorted_bam(str(root / "truth.bam"), [("ctg", len(draft))], [truth])
+    return root
+
+
+def test_parser_reference_flag_parity():
+    p = build_parser()
+    a = p.parse_args(["features", "r.fa", "x.bam", "o.h5", "--Y", "y.bam", "--t", "4"])
+    assert (a.ref, a.X, a.o, a.Y, a.t) == ("r.fa", "x.bam", "o.h5", "y.bam", 4)
+    a = p.parse_args(["train", "in/", "out/", "--val", "v/", "--b", "64", "--memory"])
+    assert (a.train, a.out, a.val, a.b) == ("in/", "out/", "v/", 64)
+    a = p.parse_args(["inference", "d.h5", "m", "o.fa", "--b", "32", "--t", "2"])
+    assert (a.data, a.model, a.out, a.b) == ("d.h5", "m", "o.fa", 32)
+
+
+def test_cli_features_train_inference(tiny_project, capsys):
+    root = tiny_project
+    rc = main([
+        "features", str(root / "draft.fasta"), str(root / "reads.bam"),
+        str(root / "train.hdf5"), "--Y", str(root / "truth.bam"), "--seed", "5",
+    ])
+    assert rc == 0 and "windows" in capsys.readouterr().out
+
+    rc = main([
+        "features", str(root / "draft.fasta"), str(root / "reads.bam"),
+        str(root / "infer.hdf5"), "--seed", "5",
+    ])
+    assert rc == 0
+
+    rc = main([
+        "train", str(root / "train.hdf5"), str(root / "ckpt"),
+        "--b", "16", "--epochs", "2", "--lr", "1e-3",
+        "--hidden-size", "16", "--num-layers", "1", "--dp", "8",
+    ])
+    assert rc == 0
+
+    rc = main([
+        "inference", str(root / "infer.hdf5"), str(root / "ckpt"),
+        str(root / "polished.fasta"), "--b", "16",
+        "--hidden-size", "16", "--num-layers", "1", "--dp", "8",
+    ])
+    assert rc == 0
+    polished = read_fasta(str(root / "polished.fasta"))
+    assert polished and polished[0][0] == "ctg"

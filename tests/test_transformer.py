@@ -80,9 +80,9 @@ def test_transformer_train_step_dp_tp(rng):
     mesh = make_mesh(MeshConfig(dp=4, tp=2))
     model = RokoModel(TRANS)
     tx = optax.adam(1e-3)
+    params = model.init(jax.random.PRNGKey(0))
     params = jax.tree.map(
-        jax.device_put, model.init(jax.random.PRNGKey(0)),
-        param_sharding(TRANS, model.init(jax.random.PRNGKey(0)), mesh),
+        jax.device_put, params, param_sharding(TRANS, params, mesh)
     )
     opt_state = tx.init(params)
     step = make_train_step(model, tx, mesh)
