@@ -160,6 +160,41 @@ def _encode_record(rec: BamRecord) -> bytes:
     return struct.pack("<i", len(body)) + body
 
 
+def _find_cg_tag(tags: bytes) -> Optional[List[int]]:
+    """Scan the tag region for a ``CG:B,I`` array — the real CIGAR of a
+    read whose op count overflows the 16-bit n_cigar field (SAM spec
+    §4.2.2). Returns raw (len<<4|op) words or None."""
+    off = 0
+    n = len(tags)
+    while off + 3 <= n:
+        t0, t1, typ = tags[off : off + 3]
+        off += 3
+        ch = chr(typ)
+        if ch in "AcC":
+            off += 1
+        elif ch in "sS":
+            off += 2
+        elif ch in "iIf":
+            off += 4
+        elif ch in "ZH":
+            end = tags.index(b"\x00", off)
+            off = end + 1
+        elif ch == "B":
+            if off + 5 > n:
+                return None
+            elem = chr(tags[off])
+            count = struct.unpack_from("<I", tags, off + 1)[0]
+            esize = {"c": 1, "C": 1, "s": 2, "S": 2}.get(elem, 4)
+            if t0 == ord("C") and t1 == ord("G") and elem == "I":
+                if off + 5 + 4 * count > n:
+                    return None
+                return list(struct.unpack_from(f"<{count}I", tags, off + 5))
+            off += 5 + esize * count
+        else:
+            return None
+    return None
+
+
 def _decode_record(body: bytes) -> BamRecord:
     (
         tid,
@@ -191,6 +226,16 @@ def _decode_record(body: bytes) -> BamRecord:
     qual = body[off : off + l_seq]
     off += l_seq
     tags = body[off:]
+    # ultralong-read CIGAR overflow: placeholder "<l_seq>S<ref_len>N" with
+    # the real CIGAR in a CG:B,I tag
+    if (
+        len(cigar) == 2
+        and cigar[0] == (C.CIGAR_S, l_seq)
+        and cigar[1][0] == C.CIGAR_N
+    ):
+        cg = _find_cg_tag(tags)
+        if cg is not None:
+            cigar = [(v & 0xF, v >> 4) for v in cg]
     return BamRecord(
         name=name,
         flag=flag,

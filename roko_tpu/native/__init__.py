@@ -1,0 +1,5 @@
+"""Native (C++) host-side extractor: BGZF/BAM I/O, pileup engine, and the
+200x90 window tensorizer, compiled to a C-ABI shared library and bound
+via ctypes. The Python implementation in roko_tpu/features/ is the
+semantic oracle; this package is the production hot path on the TPU-VM
+host (SURVEY.md §2 "Native components" note)."""

@@ -1,0 +1,113 @@
+"""ctypes binding for the native extractor (no pybind11 in the image; the
+C ABI + ctypes keeps the build a single g++ invocation)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from roko_tpu.config import ReadFilterConfig, WindowConfig
+from roko_tpu.features.extract import Window
+from roko_tpu.native import build as _build
+
+
+class _RokoResult(ctypes.Structure):
+    _fields_ = [
+        ("n_windows", ctypes.c_int64),
+        ("positions", ctypes.POINTER(ctypes.c_int64)),
+        ("matrix", ctypes.POINTER(ctypes.c_uint8)),
+    ]
+
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = _build.ensure_built()
+        lib = ctypes.CDLL(path)
+        lib.roko_native_abi_version.restype = ctypes.c_int
+        lib.roko_last_error.restype = ctypes.c_char_p
+        lib.roko_extract_windows.restype = ctypes.c_int
+        lib.roko_extract_windows.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int64,
+            ctypes.c_uint64,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.POINTER(_RokoResult),
+        ]
+        lib.roko_free_result.argtypes = [ctypes.POINTER(_RokoResult)]
+        if lib.roko_native_abi_version() != 1:
+            raise RuntimeError("native extractor ABI mismatch; rebuild")
+        _lib = lib
+        return lib
+
+
+def is_available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def extract_windows(
+    bam_path: str,
+    contig: str,
+    start: int,
+    end: int,
+    seed: int,
+    window_cfg: Optional[WindowConfig] = None,
+    filter_cfg: Optional[ReadFilterConfig] = None,
+) -> List[Window]:
+    """Native equivalent of roko_tpu.features.extract.extract_windows;
+    bit-identical output (tests/test_native.py)."""
+    wcfg = window_cfg or WindowConfig()
+    fcfg = filter_cfg or ReadFilterConfig()
+    lib = _load()
+    res = _RokoResult()
+    rc = lib.roko_extract_windows(
+        bam_path.encode(),
+        contig.encode(),
+        start,
+        end,
+        seed & (2**64 - 1),
+        wcfg.rows,
+        wcfg.cols,
+        wcfg.stride,
+        wcfg.max_ins,
+        fcfg.min_mapq,
+        fcfg.filter_flag,
+        1 if fcfg.require_proper_pair else 0,
+        ctypes.byref(res),
+    )
+    if rc != 0:
+        msg = lib.roko_last_error().decode(errors="replace")
+        raise RuntimeError(f"native extractor failed ({rc}): {msg}")
+    try:
+        n = int(res.n_windows)
+        if n == 0:
+            return []
+        pos = np.ctypeslib.as_array(res.positions, shape=(n, wcfg.cols, 2)).copy()
+        mat = np.ctypeslib.as_array(
+            res.matrix, shape=(n, wcfg.rows, wcfg.cols)
+        ).copy()
+    finally:
+        lib.roko_free_result(ctypes.byref(res))
+    return [Window(positions=pos[i], matrix=mat[i]) for i in range(n)]

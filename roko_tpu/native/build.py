@@ -1,0 +1,61 @@
+"""Build the native extractor shared library.
+
+``python -m roko_tpu.native.build`` compiles ``src/*.cc`` with g++ -O3
+into ``_roko_native.so`` next to this file (links only zlib, which every
+TPU-VM host image ships). No setuptools involvement — the library is a
+plain C-ABI .so consumed via ctypes, so there is nothing Python-version
+specific to build.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(HERE, "src")
+OUT = os.path.join(HERE, "_roko_native.so")
+
+SOURCES = ["bgzf.cc", "bam.cc", "extract.cc", "capi.cc"]
+HEADERS = ["bgzf.h", "bam.h", "extract.h"]
+
+
+def build(verbose: bool = True) -> str:
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-fPIC",
+        "-shared",
+        "-Wall",
+        "-o",
+        OUT,
+        *[os.path.join(SRC, s) for s in SOURCES],
+        "-lz",
+    ]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.run(cmd, check=True)
+    return OUT
+
+
+def is_built() -> bool:
+    if not os.path.exists(OUT):
+        return False
+    src_mtime = max(
+        os.path.getmtime(os.path.join(SRC, s)) for s in SOURCES + HEADERS
+    )
+    return os.path.getmtime(OUT) >= src_mtime
+
+
+def ensure_built(verbose: bool = False) -> str:
+    if not is_built():
+        build(verbose=verbose)
+    return OUT
+
+
+if __name__ == "__main__":
+    build()
+    print(f"built {OUT}")
+    sys.exit(0)

@@ -1,0 +1,80 @@
+// C ABI for the native extractor, consumed via ctypes
+// (roko_tpu/native/binding.py). One call per region; the caller copies
+// the returned buffers into numpy arrays and frees them.
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "extract.h"
+
+namespace {
+thread_local std::string g_last_error;
+}
+
+extern "C" {
+
+// Compile-time geometry/encoding constants, asserted against
+// roko_tpu/constants.py at binding load (single source of truth).
+int roko_native_abi_version() { return 1; }
+
+struct RokoResult {
+  int64_t n_windows;
+  int64_t* positions;  // [n_windows, cols, 2], malloc'd
+  uint8_t* matrix;     // [n_windows, rows, cols], malloc'd
+};
+
+const char* roko_last_error() { return g_last_error.c_str(); }
+
+// Returns 0 on success, nonzero on error (message via roko_last_error).
+int roko_extract_windows(const char* bam_path, const char* contig,
+                         int64_t start, int64_t end, uint64_t seed, int rows,
+                         int cols, int stride, int max_ins, int min_mapq,
+                         int filter_flag, int require_proper_pair,
+                         RokoResult* out) {
+  try {
+    roko::ExtractConfig cfg;
+    cfg.rows = rows;
+    cfg.cols = cols;
+    cfg.stride = stride;
+    cfg.max_ins = max_ins;
+    cfg.min_mapq = min_mapq;
+    cfg.filter_flag = static_cast<uint16_t>(filter_flag);
+    cfg.require_proper_pair = require_proper_pair != 0;
+
+    roko::ExtractResult res =
+        roko::ExtractWindows(bam_path, contig, start, end, seed, cfg);
+
+    out->n_windows = res.n_windows;
+    out->positions = nullptr;
+    out->matrix = nullptr;
+    if (res.n_windows > 0) {
+      out->positions = static_cast<int64_t*>(
+          std::malloc(res.positions.size() * sizeof(int64_t)));
+      out->matrix = static_cast<uint8_t*>(std::malloc(res.matrix.size()));
+      if (!out->positions || !out->matrix) {
+        std::free(out->positions);
+        std::free(out->matrix);
+        g_last_error = "out of memory";
+        return 2;
+      }
+      std::memcpy(out->positions, res.positions.data(),
+                  res.positions.size() * sizeof(int64_t));
+      std::memcpy(out->matrix, res.matrix.data(), res.matrix.size());
+    }
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return 1;
+  }
+}
+
+void roko_free_result(RokoResult* res) {
+  if (!res) return;
+  std::free(res->positions);
+  std::free(res->matrix);
+  res->positions = nullptr;
+  res->matrix = nullptr;
+  res->n_windows = 0;
+}
+
+}  // extern "C"

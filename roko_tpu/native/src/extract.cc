@@ -1,0 +1,315 @@
+#include "extract.h"
+
+#include <algorithm>
+#include <deque>
+#include <stdexcept>
+#include <unordered_map>
+#include <unordered_set>
+
+namespace roko {
+
+namespace {
+
+constexpr uint8_t kGap = 4;
+constexpr uint8_t kUnknown = 5;
+constexpr uint8_t kStrandOffset = 6;
+constexpr uint8_t kInvalid = 0xFF;
+
+// BAM seq nibble -> encoded base, matching the oracle's nibble -> char ->
+// CHAR_TO_CODE chain (roko_tpu/io/bam.py _SEQ_CODES + constants.py
+// CHAR_TO_CODE; ref nibble decode: include/models.h:120-138). Ambiguity
+// codes other than N are errors there, so kInvalid here.
+constexpr uint8_t kNibbleToCode[16] = {
+    kInvalid, 0,        1,        kInvalid,  // -, A, C, M
+    2,        kInvalid, kInvalid, kInvalid,  // G, R, S, V
+    3,        kInvalid, kInvalid, kInvalid,  // T, W, Y, H
+    kInvalid, kInvalid, kInvalid, kUnknown,  // K, D, B, N
+};
+
+struct ColState {
+  int32_t qpos;
+  bool is_del;
+  bool is_refskip;
+  int32_t indel;  // >0 insertion after this column; <0 deletion; 0 none
+};
+
+struct ReadInfo {
+  int id;
+  int32_t pos;
+  int32_t ref_end;   // exclusive (htslib bam_endpos)
+  bool reverse;
+  const std::vector<uint8_t>* seq_nib;
+  std::vector<ColState> states;
+};
+
+// Mirrors roko_tpu/features/pileup.py::_column_states (htslib pileup
+// semantics: indel flagged on the last column before an I/D op; D/N
+// columns carry the qpos of the preceding aligned base).
+std::vector<ColState> ColumnStates(const BamRecord& rec) {
+  std::vector<ColState> states;
+  int32_t qpos = 0;
+  for (uint32_t c : rec.cigar) {
+    uint32_t op = c & 0xF;
+    int32_t length = static_cast<int32_t>(c >> 4);
+    switch (op) {
+      case 0:  // M
+      case 7:  // =
+      case 8:  // X
+        for (int32_t i = 0; i < length; ++i)
+          states.push_back({qpos + i, false, false, 0});
+        qpos += length;
+        break;
+      case 1:  // I
+        if (!states.empty()) states.back().indel = length;
+        qpos += length;
+        break;
+      case 2:  // D
+        if (!states.empty() && states.back().indel <= 0)
+          states.back().indel = -length;
+        for (int32_t i = 0; i < length; ++i)
+          states.push_back({std::max(qpos - 1, 0), true, false, 0});
+        break;
+      case 3:  // N
+        for (int32_t i = 0; i < length; ++i)
+          states.push_back({std::max(qpos - 1, 0), true, true, 0});
+        break;
+      case 4:  // S
+        qpos += length;
+        break;
+      default:  // H, P consume nothing
+        break;
+    }
+  }
+  return states;
+}
+
+bool PassesFilter(const BamRecord& rec, const ExtractConfig& cfg) {
+  if (rec.flag & cfg.filter_flag) return false;
+  if (cfg.require_proper_pair && (rec.flag & 0x1) && !(rec.flag & 0x2))
+    return false;
+  if (rec.mapq < cfg.min_mapq) return false;
+  return true;
+}
+
+}  // namespace
+
+ExtractResult ExtractWindows(const std::string& bam_path,
+                             const std::string& contig, int64_t start,
+                             int64_t end, uint64_t seed,
+                             const ExtractConfig& cfg) {
+  BamReader reader(bam_path);
+  ExtractResult result;
+
+  // storage owns the records; ReadInfo borrows seq_nib pointers, so it
+  // must stay alive for the whole sweep
+  std::vector<BamRecord> storage;
+  std::vector<ReadInfo> reads;
+  {
+    std::vector<BamRecord> records = reader.Fetch(contig, start, end);
+    storage.reserve(records.size());
+    for (auto& rec : records) {
+      if (!PassesFilter(rec, cfg)) continue;
+      storage.push_back(std::move(rec));
+    }
+    int next_id = 0;
+    reads.reserve(storage.size());
+    for (auto& rec : storage) {
+      ReadInfo info;
+      info.id = next_id++;
+      info.pos = rec.pos;
+      info.ref_end = rec.ReferenceEnd();
+      info.reverse = rec.IsReverse();
+      info.seq_nib = &rec.seq_nib;
+      info.states = ColumnStates(rec);
+      reads.push_back(std::move(info));
+    }
+  }
+  if (reads.empty()) return result;
+
+  const int slots = cfg.max_ins + 1;
+  auto key_of = [slots](int64_t rpos, int ins) -> int64_t {
+    return rpos * slots + ins;
+  };
+
+  SplitMix64 rng(seed);
+  std::deque<int64_t> pos_queue;
+  // (rpos, ins) -> per-read first-seen code; insertion into the inner
+  // vector preserves "setdefault" (first write wins) via Seen lookup
+  struct ColInfo {
+    std::vector<std::pair<int, uint8_t>> codes;  // (rid, code), rid unique
+    // The sweep visits each (read, column) pair exactly once (one
+    // ColState per covered column), so rids are unique per key by
+    // construction — a plain append matches the oracle's dict setdefault
+    // without the O(coverage) membership scan.
+    void SetDefault(int rid, uint8_t code) { codes.emplace_back(rid, code); }
+  };
+  std::unordered_map<int64_t, ColInfo> align_info;
+  // rid -> (ref bounds, strand), recorded at first non-refskip entry
+  struct Bounds {
+    int32_t lo, hi;
+    bool fwd;
+  };
+  std::unordered_map<int, Bounds> bounds;
+
+  int64_t lo = reads.front().pos;
+  for (const auto& r : reads) lo = std::min<int64_t>(lo, r.pos);
+  int64_t hi = 0;
+  for (const auto& r : reads)
+    hi = std::max<int64_t>(hi, r.pos + static_cast<int64_t>(r.states.size()));
+
+  std::vector<size_t> active;
+  size_t nxt = 0;
+
+  auto encode_base = [&](const ReadInfo& r, int32_t q) -> uint8_t {
+    uint8_t code = kNibbleToCode[(*r.seq_nib)[q] & 0xF];
+    if (code == kInvalid)
+      throw std::runtime_error("unexpected base nibble in read sequence");
+    return code;
+  };
+
+  // Reused per-window scratch: one dense row per read seen in the window,
+  // built in a single pass over the columns (the per-sampled-read lazy
+  // row construction the Python oracle uses is O(cols * coverage) per
+  // sampled read; with 200 samples over ~coverage reads nearly every
+  // read is materialised anyway, so batch-building is strictly cheaper).
+  constexpr uint8_t kUnset = 0xFE;
+  std::unordered_map<int, size_t> rid_slot;
+  std::vector<int> slot_rid;
+  std::vector<std::vector<uint8_t>> rows_buf;
+  std::vector<bool> slot_valid;
+
+  auto emit_windows = [&]() {
+    while (static_cast<int>(pos_queue.size()) >= cfg.cols) {
+      rid_slot.clear();
+      slot_rid.clear();
+      rows_buf.clear();
+      slot_valid.clear();
+
+      for (int c = 0; c < cfg.cols; ++c) {
+        const ColInfo& info = align_info[pos_queue[c]];
+        for (const auto& p : info.codes) {
+          auto it = rid_slot.find(p.first);
+          size_t slot;
+          if (it == rid_slot.end()) {
+            slot = rows_buf.size();
+            rid_slot.emplace(p.first, slot);
+            slot_rid.push_back(p.first);
+            rows_buf.emplace_back(cfg.cols, kUnset);
+            slot_valid.push_back(false);
+          } else {
+            slot = it->second;
+          }
+          rows_buf[slot][c] = p.second;
+          if (p.second != kUnknown) slot_valid[slot] = true;
+        }
+      }
+
+      // valid reads: any non-UNKNOWN code within the window, sorted by id
+      std::vector<int> valid;
+      for (size_t s = 0; s < slot_rid.size(); ++s)
+        if (slot_valid[s]) valid.push_back(slot_rid[s]);
+      std::sort(valid.begin(), valid.end());
+
+      if (!valid.empty()) {
+        const size_t n_valid = valid.size();
+        // complete the rows: bounds rule for unset columns, strand offset
+        for (size_t s = 0; s < rows_buf.size(); ++s) {
+          const Bounds& b = bounds.at(slot_rid[s]);
+          std::vector<uint8_t>& row = rows_buf[s];
+          for (int c = 0; c < cfg.cols; ++c) {
+            if (row[c] == kUnset) {
+              int64_t p = pos_queue[c] / slots;
+              // NB: b.hi is htslib's exclusive bam_endpos but the test is
+              // `p > hi`, reproducing the reference's off-by-one where the
+              // one-past-the-end position reads as in-bounds GAP
+              // (ref: generate.cpp:135, kept by the Python oracle)
+              row[c] = (p < b.lo || p > b.hi) ? kUnknown : kGap;
+            }
+            if (!b.fwd) row[c] = static_cast<uint8_t>(row[c] + kStrandOffset);
+          }
+        }
+
+        size_t pos_base = result.positions.size();
+        result.positions.resize(pos_base + 2ul * cfg.cols);
+        for (int c = 0; c < cfg.cols; ++c) {
+          int64_t key = pos_queue[c];
+          result.positions[pos_base + 2 * c] = key / slots;
+          result.positions[pos_base + 2 * c + 1] = key % slots;
+        }
+
+        size_t mat_base = result.matrix.size();
+        result.matrix.resize(mat_base +
+                             static_cast<size_t>(cfg.rows) * cfg.cols);
+        for (int r = 0; r < cfg.rows; ++r) {
+          int rid = valid[rng.NextBelow(n_valid)];
+          const std::vector<uint8_t>& row = rows_buf[rid_slot.at(rid)];
+          std::copy(row.begin(), row.end(),
+                    result.matrix.begin() + mat_base +
+                        static_cast<size_t>(r) * cfg.cols);
+        }
+        result.n_windows += 1;
+      }
+      // slide by stride (empty valid set: skip but still slide)
+      for (int s = 0; s < cfg.stride; ++s) {
+        align_info.erase(pos_queue.front());
+        pos_queue.pop_front();
+      }
+    }
+  };
+
+  for (int64_t rpos = lo; rpos < hi; ++rpos) {
+    while (nxt < reads.size() && reads[nxt].pos <= rpos) active.push_back(nxt++);
+    // compact: drop exhausted reads, preserving file order
+    size_t w = 0;
+    bool any_entry = false;
+    for (size_t i = 0; i < active.size(); ++i) {
+      const ReadInfo& r = reads[active[i]];
+      int64_t col = rpos - r.pos;
+      if (col >= static_cast<int64_t>(r.states.size())) continue;
+      active[w++] = active[i];
+      any_entry = true;
+    }
+    active.resize(w);
+    if (!any_entry) {
+      if (active.empty() && nxt >= reads.size()) break;
+      continue;
+    }
+    if (rpos < start) continue;
+    if (rpos >= end) break;
+
+    for (size_t idx : active) {
+      const ReadInfo& r = reads[idx];
+      const ColState& st = r.states[static_cast<size_t>(rpos - r.pos)];
+      if (st.is_refskip) continue;
+      if (bounds.find(r.id) == bounds.end())
+        bounds.emplace(r.id, Bounds{r.pos, r.ref_end, !r.reverse});
+
+      int64_t base_key = key_of(rpos, 0);
+      auto ai = align_info.find(base_key);
+      if (ai == align_info.end()) {
+        ai = align_info.emplace(base_key, ColInfo{}).first;
+        pos_queue.push_back(base_key);
+      }
+      if (st.is_del) {
+        ai->second.SetDefault(r.id, kGap);
+      } else {
+        ai->second.SetDefault(r.id, encode_base(r, st.qpos));
+        int32_t n_ins = std::min(st.indel, cfg.max_ins);
+        for (int32_t i = 1; i <= n_ins; ++i) {
+          int64_t ikey = key_of(rpos, i);
+          auto ii = align_info.find(ikey);
+          if (ii == align_info.end()) {
+            ii = align_info.emplace(ikey, ColInfo{}).first;
+            pos_queue.push_back(ikey);
+          }
+          ii->second.SetDefault(r.id, encode_base(r, st.qpos + i));
+        }
+      }
+    }
+    emit_windows();
+  }
+
+  return result;
+}
+
+}  // namespace roko

@@ -1,0 +1,163 @@
+"""Native (C++) extractor golden tests: bit-identical windows vs the
+pure-Python oracle over varied synthetic BAMs (SURVEY.md §4 strategy)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from tests.helpers import make_record, random_seq, simulate_reads
+from roko_tpu import constants as C
+from roko_tpu.config import ReadFilterConfig, WindowConfig
+from roko_tpu.features.extract import extract_windows
+from roko_tpu.io.bam import BamReader, write_sorted_bam
+
+native = pytest.importorskip("roko_tpu.native.binding")
+if not native.is_available():  # pragma: no cover
+    pytest.skip("native extractor not built", allow_module_level=True)
+
+
+def _python_windows(bam, contig, start, end, seed, wcfg=None, fcfg=None):
+    with BamReader(bam) as reader:
+        return list(
+            extract_windows(reader, contig, start, end, seed, wcfg, fcfg)
+        )
+
+
+def _assert_same(py_windows, c_windows):
+    assert len(py_windows) == len(c_windows)
+    for pw, cw in zip(py_windows, c_windows):
+        np.testing.assert_array_equal(pw.positions, cw.positions)
+        np.testing.assert_array_equal(pw.matrix, cw.matrix)
+
+
+@pytest.mark.parametrize("seed", [0, 7, 123456789])
+def test_native_matches_python_simulated(tmp_path, seed):
+    rng = random.Random(seed + 1)
+    ref = random_seq(rng, 6000)
+    reads = simulate_reads(rng, ref, 0, coverage=25)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+
+    py = _python_windows(bam, "ctg", 0, len(ref), seed)
+    cc = native.extract_windows(bam, "ctg", 0, len(ref), seed)
+    assert py, "expected windows from simulated reads"
+    _assert_same(py, cc)
+
+
+def test_native_matches_python_subregion(tmp_path):
+    rng = random.Random(11)
+    ref = random_seq(rng, 8000)
+    reads = simulate_reads(rng, ref, 0, coverage=20)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+
+    for start, end in [(0, 3000), (2500, 5500), (5000, 8000)]:
+        py = _python_windows(bam, "ctg", start, end, 42)
+        cc = native.extract_windows(bam, "ctg", start, end, 42)
+        _assert_same(py, cc)
+
+
+def test_native_matches_python_heavy_indels(tmp_path):
+    rng = random.Random(5)
+    ref = random_seq(rng, 4000)
+    reads = simulate_reads(
+        rng, ref, 0, coverage=30, sub_rate=0.05, ins_rate=0.06, del_rate=0.06
+    )
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+
+    py = _python_windows(bam, "ctg", 0, len(ref), 99)
+    cc = native.extract_windows(bam, "ctg", 0, len(ref), 99)
+    assert py
+    _assert_same(py, cc)
+
+
+def test_native_filter_policy(tmp_path):
+    """Low-mapq / flagged reads must be excluded identically."""
+    rng = random.Random(2)
+    ref = random_seq(rng, 3000)
+    reads = simulate_reads(rng, ref, 0, coverage=15)
+    # degrade some reads
+    for i, r in enumerate(reads):
+        if i % 5 == 0:
+            reads[i] = make_record(r.name, 0, r.pos, r.seq, r.cigar, flag=r.flag, mapq=3)
+        elif i % 7 == 0:
+            reads[i] = make_record(
+                r.name, 0, r.pos, r.seq, r.cigar,
+                flag=r.flag | C.FLAG_SECONDARY, mapq=r.mapq,
+            )
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+
+    py = _python_windows(bam, "ctg", 0, len(ref), 3)
+    cc = native.extract_windows(bam, "ctg", 0, len(ref), 3)
+    _assert_same(py, cc)
+
+
+def test_native_empty_region(tmp_path):
+    rng = random.Random(4)
+    ref = random_seq(rng, 2000)
+    reads = simulate_reads(rng, ref, 0, coverage=10)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref)), ("empty", 5000)], reads)
+    assert native.extract_windows(bam, "empty", 0, 5000, 1) == []
+
+
+def test_native_unknown_contig_raises(tmp_path):
+    rng = random.Random(4)
+    ref = random_seq(rng, 1000)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], simulate_reads(rng, ref, 0, 5))
+    with pytest.raises(RuntimeError, match="unknown contig"):
+        native.extract_windows(bam, "nope", 0, 100, 1)
+
+
+def test_native_nondefault_geometry(tmp_path):
+    rng = random.Random(13)
+    ref = random_seq(rng, 3000)
+    reads = simulate_reads(rng, ref, 0, coverage=20)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+    wcfg = WindowConfig(rows=64, cols=30, stride=10, max_ins=2)
+    fcfg = ReadFilterConfig(min_mapq=20)
+    py = _python_windows(bam, "ctg", 0, len(ref), 8, wcfg, fcfg)
+    cc = native.extract_windows(bam, "ctg", 0, len(ref), 8, wcfg, fcfg)
+    assert py
+    _assert_same(py, cc)
+
+
+def test_native_cg_tag_ultralong_cigar(tmp_path):
+    """A read whose CIGAR rides in a CG:B,I tag (placeholder kS mN in the
+    fixed field) must pile up identically to the same read with an inline
+    CIGAR, in both backends."""
+    import struct
+
+    from roko_tpu.io.bam import BamRecord
+
+    rng = random.Random(21)
+    ref = random_seq(rng, 400)
+    base = simulate_reads(rng, ref, 0, coverage=12)
+
+    def with_cg(r):
+        words = [(length << 4) | op for op, length in r.cigar]
+        tags = b"CGB" + b"I" + struct.pack("<I", len(words))
+        tags += struct.pack(f"<{len(words)}I", *words)
+        ref_len = sum(l for op, l in r.cigar if C.CIGAR_CONSUMES_REF[op])
+        return BamRecord(
+            name=r.name, flag=r.flag, tid=r.tid, pos=r.pos, mapq=r.mapq,
+            cigar=((C.CIGAR_S, len(r.seq)), (C.CIGAR_N, ref_len)),
+            seq=r.seq, qual=r.qual, tags=tags,
+        )
+
+    inline_bam = str(tmp_path / "inline.bam")
+    cg_bam = str(tmp_path / "cg.bam")
+    write_sorted_bam(inline_bam, [("ctg", len(ref))], base)
+    write_sorted_bam(cg_bam, [("ctg", len(ref))], [with_cg(r) for r in base])
+
+    py_inline = _python_windows(inline_bam, "ctg", 0, len(ref), 6)
+    py_cg = _python_windows(cg_bam, "ctg", 0, len(ref), 6)
+    cc_cg = native.extract_windows(cg_bam, "ctg", 0, len(ref), 6)
+    assert py_inline, "fixture produced no windows"
+    _assert_same(py_inline, py_cg)
+    _assert_same(py_inline, cc_cg)
