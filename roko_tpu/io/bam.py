@@ -177,7 +177,9 @@ def _find_cg_tag(tags: bytes) -> Optional[List[int]]:
         elif ch in "iIf":
             off += 4
         elif ch in "ZH":
-            end = tags.index(b"\x00", off)
+            end = tags.find(b"\x00", off)
+            if end < 0:  # truncated string tag: give up gracefully
+                return None
             off = end + 1
         elif ch == "B":
             if off + 5 > n:

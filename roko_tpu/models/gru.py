@@ -102,11 +102,19 @@ def bidir_gru_stack(
 class RokoGRU:
     """Functional container: builds/holds no state, just init + apply."""
 
-    def __init__(self, in_size: int, hidden: int, num_layers: int, dropout: float):
+    def __init__(
+        self,
+        in_size: int,
+        hidden: int,
+        num_layers: int,
+        dropout: float,
+        use_pallas: bool = False,
+    ):
         self.in_size = in_size
         self.hidden = hidden
         self.num_layers = num_layers
         self.dropout = dropout
+        self.use_pallas = use_pallas
 
     def init(self, rng: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], ...]:
         layers = []
@@ -122,6 +130,15 @@ class RokoGRU:
         return tuple(layers)
 
     def apply(self, params, x, *, deterministic=True, rng=None):
+        # The fused Pallas kernel is inference-only (no dropout and no
+        # custom VJP); training always takes the lax.scan path.
+        if self.use_pallas and deterministic:
+            from roko_tpu.models.pallas_gru import bidir_gru_stack_pallas
+
+            interpret = jax.default_backend() != "tpu"
+            return bidir_gru_stack_pallas(
+                params, x, interpret=interpret, compute_dtype=x.dtype
+            )
         return bidir_gru_stack(
             params,
             x,

@@ -59,6 +59,7 @@ class RokoModel:
             self.cfg.hidden_size,
             self.cfg.num_layers,
             self.cfg.dropout,
+            use_pallas=self.cfg.use_pallas,
         )
 
     # -- init ---------------------------------------------------------------
@@ -100,17 +101,31 @@ class RokoModel:
             assert rng is not None, "training forward needs a dropout rng"
             rngs = list(jax.random.split(rng, 4))
 
-        e = jnp.take(params["embedding"], x, axis=0)  # [B,200,90,50]
-        e = e.astype(dtype)
         if train:
+            e = jnp.take(params["embedding"], x, axis=0)  # [B,200,90,50]
+            e = e.astype(dtype)
             e = _dropout(rngs[0], e, cfg.dropout)
-
-        # read axis (200) to the back: [B,90,50,200]
-        e = e.transpose(0, 2, 3, 1)
-
-        h = jax.nn.relu(_dense(cast_tree(params["fc1"], dtype), e))
-        if train:
+            # read axis (200) to the back: [B,90,50,200]
+            e = e.transpose(0, 2, 3, 1)
+            h = jax.nn.relu(_dense(cast_tree(params["fc1"], dtype), e))
             h = _dropout(rngs[1], h, cfg.dropout)
+        else:
+            # Inference fast path: embedding-gather + transpose + fc1 is
+            # algebraically  relu(E[x]^T(r-axis) @ W1 + b1)  =
+            # relu(E^T @ (onehot(x)^T(r) @ W1) + b1)  because the vocab is
+            # tiny (12). Reassociating turns a 230 MB gather + relayout
+            # (the measured hot spot: ~59 ms of a 75 ms batch-128 forward
+            # on v5e) into two MXU einsums over a [*,12] axis. Same math
+            # as the reference chain (roko/rnn_model.py:47-51) up to float
+            # summation order; only valid without the per-element dropout
+            # between embed and fc1, hence inference-only.
+            onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
+            w1 = params["fc1"]["kernel"].astype(dtype)  # [200, J]
+            # contract the read axis first: [B,T,V,J]
+            m = jnp.einsum("brtv,rj->btvj", onehot, w1)
+            emb = params["embedding"].astype(dtype)  # [V, D]
+            h = jnp.einsum("vd,btvj->btdj", emb, m)  # [B,T,D,J]
+            h = jax.nn.relu(h + params["fc1"]["bias"].astype(dtype))
         h = jax.nn.relu(_dense(cast_tree(params["fc2"], dtype), h))
         if train:
             h = _dropout(rngs[2], h, cfg.dropout)

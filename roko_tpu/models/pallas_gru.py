@@ -1,0 +1,142 @@
+"""Fused Pallas TPU kernel for the GRU recurrence.
+
+The hot loop of the consensus model is 90 timesteps x 2 directions x 3
+layers of GRU steps (SURVEY.md §7 "hard parts" (a)). The lax.scan path
+re-materialises the hidden state through HBM every step; this kernel
+runs one whole direction's recurrence inside a single Pallas program
+with the hidden state pinned in a VMEM scratch buffer, so the serial
+chain touches HBM only for the per-step x-projection read and output
+write.
+
+Layout choices:
+- the input projection ``x @ W_ih + b_ih`` stays OUTSIDE the kernel —
+  one large [B*T, in] x [in, 3H] MXU matmul that XLA already schedules
+  well (same hoisting as the scan path, roko_tpu/models/gru.py:11-14);
+- time-major [T, B, 3H] so the serial loop indexes the leading axis;
+- x_proj is cast to the model compute dtype for the VMEM residency
+  (bfloat16 halves VMEM pressure: [90,128,384] bf16 = 8.8 MB); the
+  recurrence itself accumulates in float32;
+- H=128 keeps every matmul lane-aligned (MXU 128x128).
+
+The kernel is inference-only: training keeps the lax.scan path (whose
+VJP XLA derives automatically). ``interpret=True`` makes the same
+kernel run on CPU for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gru_kernel(T: int, hidden: int, reverse: bool, out_dtype):
+    def kernel(xp_ref, whh_ref, bhh_ref, out_ref, h_scratch):
+        h_scratch[...] = jnp.zeros_like(h_scratch)
+
+        def step(i, _):
+            t = (T - 1 - i) if reverse else i
+            xp = xp_ref[t].astype(jnp.float32)  # [B, 3H]
+            h = h_scratch[...]
+            hp = (
+                jnp.dot(
+                    h,
+                    whh_ref[...].astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )
+                + bhh_ref[...].astype(jnp.float32)
+            )
+            r = jax.nn.sigmoid(xp[:, :hidden] + hp[:, :hidden])
+            z = jax.nn.sigmoid(
+                xp[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden]
+            )
+            n = jnp.tanh(xp[:, 2 * hidden :] + r * hp[:, 2 * hidden :])
+            h_new = (1.0 - z) * n + z * h
+            h_scratch[...] = h_new
+            out_ref[t] = h_new.astype(out_dtype)
+            return 0
+
+        jax.lax.fori_loop(0, T, step, 0)
+
+    return kernel
+
+
+@functools.partial(
+    jax.jit, static_argnames=("reverse", "interpret", "compute_dtype")
+)
+def gru_direction_pallas(
+    params: Dict[str, jax.Array],
+    x: jax.Array,  # [B, T, in]
+    reverse: bool = False,
+    *,
+    interpret: bool = False,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """One direction of one GRU layer, [B,T,in] -> [B,T,H]; numerics
+    match roko_tpu.models.gru.gru_direction (same gate math, float32
+    accumulation)."""
+    hidden = params["w_hh"].shape[0]
+    B, T, _ = x.shape
+
+    x_proj = x @ params["w_ih"] + params["b_ih"]  # [B,T,3H] big MXU matmul
+    x_proj = x_proj.swapaxes(0, 1).astype(compute_dtype)  # [T,B,3H]
+
+    # batch-block the grid so x_proj residency stays within VMEM: Pallas
+    # double-buffers in/out blocks, so the budget is 2x(x_proj block +
+    # out block); [90, 64, 384] bf16 = 4.4 MB keeps the total ~12 MB.
+    # Blocks are independent recurrences, so the sequential TPU grid
+    # just re-runs the T-loop per block. Odd batch sizes are padded up to
+    # the block multiple (zero rows recur independently; sliced off).
+    b_blk = B if B <= 64 else 64
+    pad = (-B) % b_blk
+    if pad:
+        x_proj = jnp.concatenate(
+            [x_proj, jnp.zeros((T, pad, x_proj.shape[2]), x_proj.dtype)], axis=1
+        )
+
+    Bp = B + pad
+    out = pl.pallas_call(
+        _gru_kernel(T, hidden, reverse, x_proj.dtype),
+        grid=(Bp // b_blk,),
+        out_shape=jax.ShapeDtypeStruct((T, Bp, hidden), x_proj.dtype),
+        in_specs=[
+            pl.BlockSpec((T, b_blk, 3 * hidden), lambda i: (0, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden, 3 * hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * hidden), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((T, b_blk, hidden), lambda i: (0, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((b_blk, hidden), jnp.float32)],
+        interpret=interpret,
+    )(x_proj, params["w_hh"], params["b_hh"].reshape(1, -1))
+
+    if pad:
+        out = out[:, :B]
+    return out.swapaxes(0, 1).astype(jnp.float32)  # [B,T,H]
+
+
+def bidir_gru_stack_pallas(
+    params,
+    x: jax.Array,
+    *,
+    interpret: bool = False,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """Stacked bidirectional GRU on the fused kernel, [B,T,in] ->
+    [B,T,2H]. Inference only (no dropout, no VJP)."""
+    for layer in params:
+        fwd = gru_direction_pallas(
+            layer["fwd"], x, False, interpret=interpret, compute_dtype=compute_dtype
+        )
+        bwd = gru_direction_pallas(
+            layer["bwd"], x, True, interpret=interpret, compute_dtype=compute_dtype
+        )
+        x = jnp.concatenate([fwd, bwd], axis=-1)
+    return x

@@ -24,39 +24,52 @@ class _RokoResult(ctypes.Structure):
 
 
 _lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[Exception] = None
 _lib_lock = threading.Lock()
 
 
 def _load() -> ctypes.CDLL:
-    global _lib
+    global _lib, _load_error
     with _lib_lock:
         if _lib is not None:
             return _lib
-        path = _build.ensure_built()
-        lib = ctypes.CDLL(path)
-        lib.roko_native_abi_version.restype = ctypes.c_int
-        lib.roko_last_error.restype = ctypes.c_char_p
-        lib.roko_extract_windows.restype = ctypes.c_int
-        lib.roko_extract_windows.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_uint64,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.c_int,
-            ctypes.POINTER(_RokoResult),
-        ]
-        lib.roko_free_result.argtypes = [ctypes.POINTER(_RokoResult)]
-        if lib.roko_native_abi_version() != 1:
-            raise RuntimeError("native extractor ABI mismatch; rebuild")
-        _lib = lib
-        return lib
+        if _load_error is not None:
+            # don't re-run a failing g++ per region (thousands of calls)
+            raise _load_error
+        try:
+            return _load_locked()
+        except Exception as e:
+            _load_error = e
+            raise
+
+
+def _load_locked() -> ctypes.CDLL:
+    global _lib
+    path = _build.ensure_built()
+    lib = ctypes.CDLL(path)
+    lib.roko_native_abi_version.restype = ctypes.c_int
+    lib.roko_last_error.restype = ctypes.c_char_p
+    lib.roko_extract_windows.restype = ctypes.c_int
+    lib.roko_extract_windows.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(_RokoResult),
+    ]
+    lib.roko_free_result.argtypes = [ctypes.POINTER(_RokoResult)]
+    if lib.roko_native_abi_version() != 1:
+        raise RuntimeError("native extractor ABI mismatch; rebuild")
+    _lib = lib
+    return lib
 
 
 def is_available() -> bool:

@@ -22,6 +22,9 @@ HEADERS = ["bgzf.h", "bam.h", "extract.h"]
 
 
 def build(verbose: bool = True) -> str:
+    # link to a temp path + atomic rename: concurrent pipeline workers
+    # may race to build, and a half-written .so must never be dlopen'd
+    tmp = f"{OUT}.tmp.{os.getpid()}"
     cmd = [
         "g++",
         "-O3",
@@ -30,13 +33,18 @@ def build(verbose: bool = True) -> str:
         "-shared",
         "-Wall",
         "-o",
-        OUT,
+        tmp,
         *[os.path.join(SRC, s) for s in SOURCES],
         "-lz",
     ]
     if verbose:
         print(" ".join(cmd))
-    subprocess.run(cmd, check=True)
+    try:
+        subprocess.run(cmd, check=True)
+        os.replace(tmp, OUT)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return OUT
 
 

@@ -186,19 +186,33 @@ const std::vector<std::vector<uint64_t>>* BamReader::LoadLinearIndex() {
   std::fclose(fh);
   if (size < 8 || std::memcmp(data.data(), kBaiMagic, 4) != 0)
     throw BgzfError(bai_path + ": not a BAI index");
+  const size_t n = data.size();
   size_t off = 4;
+  auto need = [&](size_t count) {
+    if (off + count > n) throw BgzfError(bai_path + ": truncated BAI index");
+  };
+  need(4);
   int32_t n_ref = ReadI32(data.data() + off);
   off += 4;
+  if (n_ref < 0) throw BgzfError(bai_path + ": corrupt BAI index");
   linear_index_.resize(n_ref);
   for (int32_t r = 0; r < n_ref; ++r) {
+    need(4);
     int32_t n_bin = ReadI32(data.data() + off);
     off += 4;
+    if (n_bin < 0) throw BgzfError(bai_path + ": corrupt BAI index");
     for (int32_t b = 0; b < n_bin; ++b) {
+      need(8);
       int32_t n_chunk = ReadI32(data.data() + off + 4);
-      off += 8 + 16 * static_cast<size_t>(n_chunk);
+      if (n_chunk < 0) throw BgzfError(bai_path + ": corrupt BAI index");
+      need(8 + 16ul * n_chunk);
+      off += 8 + 16ul * static_cast<size_t>(n_chunk);
     }
+    need(4);
     int32_t n_intv = ReadI32(data.data() + off);
     off += 4;
+    if (n_intv < 0) throw BgzfError(bai_path + ": corrupt BAI index");
+    need(8ul * n_intv);
     linear_index_[r].resize(n_intv);
     std::memcpy(linear_index_[r].data(), data.data() + off, 8ul * n_intv);
     off += 8ul * n_intv;

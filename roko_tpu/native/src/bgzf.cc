@@ -56,6 +56,8 @@ bool BgzfReader::LoadBlockAt(uint64_t coffset) {
     i += 4 + slen;
   }
   if (bsize < 0) throw BgzfError(path_ + ": BGZF BC subfield missing");
+  if (static_cast<size_t>(bsize) + 1 < kHeaderSize + xlen + 8)
+    throw BgzfError(path_ + ": corrupt BGZF block size");
 
   size_t cdata_len =
       static_cast<size_t>(bsize) + 1 - kHeaderSize - xlen - 8;  // minus CRC+ISIZE
