@@ -131,14 +131,14 @@ class RokoGRU:
 
     def apply(self, params, x, *, deterministic=True, rng=None):
         # The fused Pallas kernel is inference-only (no dropout and no
-        # custom VJP); training always takes the lax.scan path.
-        if self.use_pallas and deterministic:
+        # custom VJP); training always takes the lax.scan path. Off-TPU
+        # the flag is ignored too — interpret-mode Pallas is orders of
+        # magnitude slower than the numerically-identical scan, and
+        # use_pallas can ride along in checkpointed configs.
+        if self.use_pallas and deterministic and jax.default_backend() == "tpu":
             from roko_tpu.models.pallas_gru import bidir_gru_stack_pallas
 
-            interpret = jax.default_backend() != "tpu"
-            return bidir_gru_stack_pallas(
-                params, x, interpret=interpret, compute_dtype=x.dtype
-            )
+            return bidir_gru_stack_pallas(params, x, compute_dtype=x.dtype)
         return bidir_gru_stack(
             params,
             x,

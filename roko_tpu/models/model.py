@@ -46,8 +46,12 @@ class RokoModel:
     """Functional model: ``init`` builds the param pytree, ``apply`` runs
     the forward pass. ``apply`` is pure and jit/shard_map friendly."""
 
-    def __init__(self, cfg: Optional[ModelConfig] = None):
+    def __init__(self, cfg: Optional[ModelConfig] = None, attn_fn=None):
+        """``attn_fn`` injects a custom attention (e.g. the ring
+        sequence-parallel one from roko_tpu/parallel/ring.py) into the
+        transformer variant; None uses dense attention."""
         self.cfg = cfg or ModelConfig()
+        self.attn_fn = attn_fn
         if self.cfg.kind not in ("gru", "transformer"):
             raise ValueError(f"unknown model kind: {self.cfg.kind}")
         if self.cfg.kind == "transformer":
@@ -143,7 +147,7 @@ class RokoModel:
                 rng=rngs[3] if train else None,
             )
         else:
-            from roko_tpu.models.transformer import transformer_apply
+            from roko_tpu.models.transformer import attention, transformer_apply
 
             h = transformer_apply(
                 cast_tree(params["encoder"], dtype),
@@ -151,6 +155,7 @@ class RokoModel:
                 h,
                 deterministic=deterministic,
                 rng=rngs[3] if train else None,
+                attn_fn=self.attn_fn or attention,
             )
 
         logits = _dense(params["head"], h.astype(jnp.float32))

@@ -119,7 +119,10 @@ def gru_direction_pallas(
 
     if pad:
         out = out[:, :B]
-    return out.swapaxes(0, 1).astype(jnp.float32)  # [B,T,H]
+    # stay in compute_dtype between layers so the next layer's hoisted
+    # input projection keeps bf16 MXU throughput; the stack casts the
+    # final output to f32
+    return out.swapaxes(0, 1)  # [B,T,H] compute_dtype
 
 
 def bidir_gru_stack_pallas(
@@ -139,4 +142,4 @@ def bidir_gru_stack_pallas(
             layer["bwd"], x, True, interpret=interpret, compute_dtype=compute_dtype
         )
         x = jnp.concatenate([fwd, bwd], axis=-1)
-    return x
+    return x.astype(jnp.float32)

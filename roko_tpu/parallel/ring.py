@@ -1,0 +1,112 @@
+"""Ring attention: sequence-parallel attention over the ``sp`` mesh axis.
+
+The reference has no sequence parallelism of any kind (SURVEY.md §2
+parallelism inventory; §5.7 explains why roko's 90-column windows don't
+need it). The framework still ships it as a first-class capability for
+the transformer variant at long context: each device holds a sequence
+shard of Q/K/V, computes blockwise attention against the K/V block it
+currently owns, and rotates K/V around the ring with ``lax.ppermute``
+over ICI while accumulating an online (streaming) softmax — the
+Liu et al. blockwise/ring-attention construction. Communication volume
+per device is O(T/sp · D) per step, overlapping with the local matmul.
+
+Exactness: the online-softmax accumulation makes the result identical
+(up to float reassociation) to dense attention over the full sequence —
+asserted by tests/test_ring.py on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from roko_tpu.parallel.mesh import AXIS_DP, AXIS_SP
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [B, Tq, D] local query shard
+    k: jax.Array,  # [B, Tk, D] local key shard
+    v: jax.Array,  # [B, Tk, D] local value shard
+    num_heads: int,
+    axis_name: str,
+    n_shards: int,
+):
+    """Runs inside shard_map: blockwise attention with K/V ring rotation.
+
+    The ring loop is unrolled over the (static) sp extent so the last
+    iteration can skip its rotation — no wasted ICI transfer — and so
+    XLA can overlap each rotation with the next block's matmuls.
+    """
+    B, Tq, D = q.shape
+    H = num_heads
+    hd = D // H
+    scale = 1.0 / math.sqrt(hd)
+
+    def heads(x):  # [B,T,D] -> [B,H,T,hd]
+        return x.reshape(B, -1, H, hd).transpose(0, 2, 1, 3)
+
+    qh = heads(q) * scale
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    # online softmax state
+    o = jnp.zeros((B, H, Tq, hd), jnp.float32)
+    m = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Tq), jnp.float32)
+
+    k_blk, v_blk = k, v
+    for i in range(n_shards):
+        kh = heads(k_blk)
+        vh = heads(v_blk)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qh, kh, preferred_element_type=jnp.float32
+        )  # [B,H,Tq,Tk]
+        new_m = jnp.maximum(m, s.max(axis=-1))
+        # rescale previous accumulators, add this block's contribution
+        alpha = jnp.exp(m - new_m)  # [B,H,Tq]
+        p = jnp.exp(s - new_m[..., None])
+        o = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, vh.astype(jnp.float32)
+        )
+        l = l * alpha + p.sum(axis=-1)
+        m = new_m
+        if i + 1 < n_shards:
+            # rotate K/V to the next device on the ring (ICI neighbour)
+            k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).reshape(B, Tq, D).astype(q.dtype)
+
+
+def make_ring_attention(mesh: Mesh, num_heads: int):
+    """Returns an ``attn_fn(q, k, v, num_heads)`` drop-in for
+    roko_tpu.models.transformer.attention that shards the sequence axis
+    over the mesh's ``sp`` axis and runs the ring construction. Batch
+    stays sharded over ``dp`` (every axis a caller shards must appear in
+    the specs, or shard_map would all-gather and replicate the work)."""
+    spec = P(AXIS_DP, AXIS_SP, None)
+
+    local = partial(
+        _ring_attention_local,
+        num_heads=num_heads,
+        axis_name=AXIS_SP,
+        n_shards=mesh.shape[AXIS_SP],
+    )
+    sharded = shard_map(
+        lambda q, k, v: local(q, k, v),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+
+    def attn_fn(q, k, v, heads):
+        assert heads == num_heads, "ring attention head count fixed at build"
+        return sharded(q, k, v)
+
+    return attn_fn
