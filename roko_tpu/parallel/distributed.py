@@ -1,0 +1,69 @@
+"""Multi-host initialisation for TPU pods.
+
+The reference has no distributed backend to replace (SURVEY.md §5.8);
+this is the TPU-native runtime entry: on a TPU-VM pod slice each host
+calls :func:`initialize` once before any jax computation, after which
+``jax.devices()`` spans the whole slice and the dp/tp/sp mesh from
+``roko_tpu.parallel.mesh`` lays shardings over ICI (and DCN across
+slices if a multi-slice topology is ever used). Collectives themselves
+are XLA's — nothing here exchanges data.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialise ``jax.distributed`` when running multi-host.
+
+    With no arguments, TPU-VM metadata autodetects the topology
+    (``jax.distributed.initialize()``'s default path). Returns True if
+    distributed mode was initialised, False for single-host runs (no
+    coordinator reachable / single process) — callers can proceed
+    either way.
+    """
+    # Decide single-host purely from env/args BEFORE importing anything
+    # that could touch jax state: even jax.process_count() initialises
+    # the local backend, after which distributed init is impossible.
+    explicit = coordinator_address or os.environ.get("ROKO_COORDINATOR")
+    # TPU_WORKER_HOSTNAMES is set even on single-worker VMs; only a
+    # comma-separated list indicates an actual pod slice
+    workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    single_host = (
+        explicit is None
+        and num_processes is None
+        and "," not in workers
+        and os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") is None
+    )
+    if single_host:
+        return False
+
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=explicit,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            pass  # initialise called twice: keep the existing topology
+        else:
+            # e.g. called after a jax computation initialised the backend —
+            # a real ordering bug at the call site; don't mask it
+            raise
+    return jax.process_count() > 1
+
+
+def is_primary() -> bool:
+    """True on the host that should write checkpoints / logs."""
+    import jax
+
+    return jax.process_index() == 0

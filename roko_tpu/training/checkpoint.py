@@ -18,6 +18,15 @@ import orbax.checkpoint as ocp
 
 
 class CheckpointManager:
+    """Best-k checkpoints by val_acc PLUS an always-current ``latest``.
+
+    The best-k manager prunes by metric only — with no latest-step
+    exemption, a long post-peak plateau would leave resume pointing at
+    a checkpoint many epochs old. ``save`` therefore also overwrites a
+    standalone ``latest`` checkpoint every call; ``restore_latest``
+    prefers it.
+    """
+
     def __init__(self, directory: str, keep: int = 3):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -29,6 +38,11 @@ class CheckpointManager:
                 best_mode="max",
             ),
         )
+        self._ckptr = ocp.StandardCheckpointer()
+
+    @property
+    def _latest_path(self) -> str:
+        return os.path.join(self.directory, "latest")
 
     def save(self, step: int, state: Dict[str, Any], val_acc: float) -> None:
         self._mgr.save(
@@ -36,9 +50,11 @@ class CheckpointManager:
             args=ocp.args.StandardSave(state),
             metrics={"val_acc": float(val_acc)},
         )
+        self._ckptr.save(self._latest_path, state, force=True)
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._ckptr.wait_until_finished()
 
     def _restore(self, step: Optional[int], like: Optional[Dict[str, Any]]):
         if step is None:
@@ -49,6 +65,12 @@ class CheckpointManager:
         return self._mgr.restore(step)
 
     def restore_latest(self, like=None) -> Optional[Dict[str, Any]]:
+        if os.path.exists(self._latest_path):
+            self._ckptr.wait_until_finished()
+            if like is not None:
+                target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+                return self._ckptr.restore(self._latest_path, target)
+            return self._ckptr.restore(self._latest_path)
         return self._restore(self._mgr.latest_step(), like)
 
     def restore_best(self, like=None) -> Optional[Dict[str, Any]]:
@@ -59,7 +81,9 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._ckptr.wait_until_finished()
         self._mgr.close()
+        self._ckptr.close()
 
 
 def load_params(path: str) -> Dict[str, Any]:

@@ -154,10 +154,16 @@ def train(
     val_path: Optional[str] = None,
     *,
     mesh: Optional[Mesh] = None,
+    resume: bool = True,
     log: Callable[[str], None] = print,
 ) -> TrainState:
     """Full training run; returns the final state. Best-k checkpoints by
-    validation accuracy land in ``out_dir`` (ref flow: roko/train.py:18-111)."""
+    validation accuracy land in ``out_dir`` (ref flow: roko/train.py:18-111).
+
+    Checkpoints carry optimizer state and step, so an interrupted run
+    restarts from its latest checkpoint when ``resume`` is set (the
+    early-stopping patience counter restarts; the reference had no
+    resume at all, SURVEY.md §5.3-5.4)."""
     tcfg = cfg.train
     mesh = mesh or make_mesh(cfg.mesh)
     dp = mesh.shape[AXIS_DP]
@@ -198,8 +204,28 @@ def train(
     np_rng = np.random.default_rng(tcfg.seed)
     params, opt_state, step_no = state.params, state.opt_state, state.step
 
+    # the saved state carries the epoch explicitly — deriving it from
+    # step // steps_per_epoch would break on resume with a different
+    # batch size or dataset
+    ckpt_like = dict(state.as_dict(), epoch=jnp.zeros((), jnp.int32))
+    start_epoch = 0
+    if resume:
+        restored = manager.restore_latest(like=ckpt_like)
+        if restored is not None:
+            params = jax.device_put(restored["params"], repl)
+            opt_state = jax.device_put(restored["opt_state"], repl)
+            step_no = jnp.asarray(restored["step"], jnp.int32)
+            start_epoch = int(jax.device_get(restored["epoch"])) + 1
+            log(
+                f"resumed from step {int(jax.device_get(step_no))} "
+                f"(epoch {start_epoch})"
+            )
+            # keep the host RNG stream aligned with the completed epochs
+            for _ in range(start_epoch):
+                np_rng.permutation(len(train_ds))
+
     try:
-        for epoch in range(tcfg.epochs):
+        for epoch in range(start_epoch, tcfg.epochs):
             t0 = time.perf_counter()
             # pad the trailing batch (zero-weight rows) instead of dropping
             # it: fixed shapes for XLA, but every window trains (the
@@ -232,7 +258,12 @@ def train(
 
             manager.save(
                 int(jax.device_get(step_no)),
-                {"params": params, "opt_state": opt_state, "step": step_no},
+                {
+                    "params": params,
+                    "opt_state": opt_state,
+                    "step": step_no,
+                    "epoch": jnp.asarray(epoch, jnp.int32),
+                },
                 acc,
             )
 

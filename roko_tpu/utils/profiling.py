@@ -1,0 +1,76 @@
+"""Tracing / profiling utilities.
+
+The reference has no observability beyond prints and a tqdm bar
+(SURVEY.md §5.1). This module provides the two tools the pipeline
+stages use:
+
+- :class:`StageTimer` — lightweight named wall-clock spans with a
+  summary table, for host-side stage attribution (feature extraction,
+  H2D, device compute, vote merge, stitch);
+- :func:`device_trace` — context manager around ``jax.profiler`` that
+  writes a TensorBoard-loadable XPlane trace when a directory is given
+  and is a no-op otherwise, so callers can leave it in place
+  unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Iterator, Optional
+
+
+class StageTimer:
+    """Accumulates wall-clock time per named stage.
+
+    >>> timer = StageTimer()
+    >>> with timer("extract"):
+    ...     do_work()
+    >>> timer.report(print)
+    """
+
+    def __init__(self) -> None:
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+
+    @contextlib.contextmanager
+    def __call__(self, stage: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[stage] += time.perf_counter() - t0
+            self.counts[stage] += 1
+
+    def report(self, log: Callable[[str], None] = print) -> None:
+        if not self.totals:
+            return
+        width = max(len(s) for s in self.totals)
+        total = sum(self.totals.values())
+        for stage, t in sorted(self.totals.items(), key=lambda kv: -kv[1]):
+            log(
+                f"  {stage:<{width}}  {t:8.2f}s  {100 * t / max(total, 1e-9):5.1f}%"
+                f"  ({self.counts[stage]} spans)"
+            )
+
+
+@contextlib.contextmanager
+def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """``jax.profiler.trace`` when ``trace_dir`` is set; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region that shows up in device traces (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield

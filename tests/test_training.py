@@ -114,3 +114,68 @@ def test_evaluate_padding_unbiased(rng):
     acc_full, _ = evaluate(step, params, ds_all, 8, mesh)
     acc_ragged, _ = evaluate(step, params, ds_all, 16, mesh)  # 24 = 16 + pad(8)
     assert acc_full == pytest.approx(acc_ragged, abs=1e-6)
+
+
+def test_train_resume_from_checkpoint(rng, tmp_path):
+    """An interrupted run restarts from its latest checkpoint instead of
+    from scratch (SURVEY §5.3 build note — the reference had no resume)."""
+    X, Y = _window_batch(rng, 64)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=2, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    train(cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"))
+
+    cfg4 = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=4, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    state = train(
+        cfg4, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+    assert any("resumed from step 8" in l for l in logs)  # 2 epochs x 4 steps
+    assert int(jax.device_get(state.step)) == 16  # continued to epoch 4
+
+    # epoch is carried in the checkpoint, so resuming with a different
+    # batch size still continues from the right epoch
+    cfg5 = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=32, epochs=5, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    logs2 = []
+    state = train(
+        cfg5, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs2.append,
+    )
+    assert any("(epoch 4)" in l for l in logs2)
+    assert int(jax.device_get(state.step)) == 16 + 2  # one epoch of 2 steps
+
+
+def test_stage_timer_and_trace():
+    from roko_tpu.utils.profiling import StageTimer, device_trace
+
+    t = StageTimer()
+    with t("a"):
+        pass
+    with t("a"):
+        pass
+    with t("b"):
+        pass
+    lines = []
+    t.report(lines.append)
+    assert len(lines) == 2 and any("2 spans" in l for l in lines)
+    with device_trace(None):  # no-op path
+        pass
+
+
+def test_distributed_single_host_noop():
+    from roko_tpu.parallel.distributed import initialize, is_primary
+
+    assert initialize() is False  # no coordinator configured
+    assert is_primary()
