@@ -77,7 +77,10 @@ def cmd_train(args: argparse.Namespace) -> int:
     from roko_tpu.training.loop import train
 
     cfg = _build_config(args)
-    train(cfg, args.train, args.out, val_path=args.val)
+    train(
+        cfg, args.train, args.out, val_path=args.val,
+        resume=args.resume, trace_dir=args.trace_dir,
+    )
     return 0
 
 
@@ -92,7 +95,10 @@ def cmd_inference(args: argparse.Namespace) -> int:
         params = load_torch_checkpoint(args.model, cfg.model)
     else:
         params = load_params(args.model)
-    polish_to_fasta(args.data, params, args.out, cfg, batch_size=args.b)
+    polish_to_fasta(
+        args.data, params, args.out, cfg, batch_size=args.b,
+        trace_dir=args.trace_dir,
+    )
     print(f"wrote polished contigs to {args.out}")
     return 0
 
@@ -141,11 +147,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=1e-4)
     p.add_argument("--patience", type=int, default=7)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace of the first epoch here")
+    p.add_argument(
+        "--no-resume",
+        dest="resume",
+        action="store_false",
+        default=True,
+        help="start fresh even if the checkpoint dir has a latest state",
+    )
     p.add_argument(
         "--memory",
         action="store_true",
         default=True,
-        help="keep dataset in host RAM (ref --memory; always on here)",
+        help="keep dataset in host RAM (ref --memory; the default)",
+    )
+    p.add_argument(
+        "--no-memory",
+        dest="memory",
+        action="store_false",
+        help="stream batches from HDF5 instead of loading into RAM",
     )
     _model_args(p)
     _mesh_args(p)
@@ -159,6 +179,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--t", type=int, default=0, help="accepted for reference parity (unused)"
     )
+    p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace here")
     _model_args(p)
     _mesh_args(p)
     p.set_defaults(fn=cmd_inference)

@@ -47,6 +47,7 @@ from roko_tpu.parallel.mesh import (
     replicated_sharding,
 )
 from roko_tpu.training.data import prefetch_to_device
+from roko_tpu.utils.profiling import StageTimer, device_trace
 
 Params = Dict[str, Any]
 
@@ -131,10 +132,12 @@ def run_inference(
     mesh: Optional[Mesh] = None,
     batch_size: int = 128,
     prefetch: int = 2,
+    trace_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> Dict[str, str]:
     """Predict votes for every window in ``data_path`` and stitch each
-    contig; returns {contig: polished_seq}."""
+    contig; returns {contig: polished_seq}. ``trace_dir`` writes a
+    TensorBoard-loadable device trace of the batch loop."""
     cfg = cfg or RokoConfig()
     mesh = mesh or make_mesh(cfg.mesh)
     dp = mesh.shape[AXIS_DP]
@@ -148,6 +151,7 @@ def run_inference(
 
     contigs = load_contigs(data_path)
     board = VoteBoard(contigs)
+    timer = StageTimer()
 
     def place(item):
         names, positions, x = item
@@ -155,16 +159,22 @@ def run_inference(
         if n < batch_size:  # fixed shapes keep one compiled executable
             pad = batch_size - n
             x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        # device_put dispatches asynchronously, so timing it here would
+        # read ~0 and misattribute the transfer to the predict span —
+        # transfer cost shows up inside "predict+d2h"
         return names, positions, jax.device_put(x, sharding), n
 
     t0 = time.perf_counter()
     n_windows = 0
-    for names, positions, x, n in prefetch_to_device(
-        iter_inference_windows(data_path, batch_size), prefetch, place
-    ):
-        preds = np.asarray(jax.device_get(predict(params, x)))[:n]
-        board.add(names, positions, preds)
-        n_windows += n
+    with device_trace(trace_dir):
+        for names, positions, x, n in prefetch_to_device(
+            iter_inference_windows(data_path, batch_size), prefetch, place
+        ):
+            with timer("predict+d2h"):
+                preds = np.asarray(jax.device_get(predict(params, x)))[:n]
+            with timer("vote"):
+                board.add(names, positions, preds)
+            n_windows += n
     dt = time.perf_counter() - t0
     log(
         f"inference: {n_windows} windows in {dt:.1f}s "
@@ -172,7 +182,10 @@ def run_inference(
         f"{n_windows * C.WINDOW_STRIDE / max(dt, 1e-9):.0f} bases/s)"
     )
 
-    return {name: board.stitch(name) for name in contigs}
+    with timer("stitch"):
+        polished = {name: board.stitch(name) for name in contigs}
+    timer.report(log)
+    return polished
 
 
 def polish_to_fasta(

@@ -1,0 +1,128 @@
+"""Out-of-core training data: stream batches from HDF5 without loading
+the dataset into RAM.
+
+The reference's lazy ``TrainDataset`` builds a flat ``idx -> (file,
+group, offset)`` map and relies on torch DataLoader workers re-opening
+fds (ref: roko/datasets.py:20-80). Random single-example reads are
+pathological for HDF5 chunk caching, so this implementation shuffles at
+two granularities instead: a seeded permutation over *chunks* of
+consecutive examples per group, and an in-memory shuffle buffer of
+several chunks that decorrelates neighbours before batching. Sequential
+chunk reads keep HDF5 I/O streaming while the shuffle quality stays
+close to a full permutation for training purposes.
+
+Exposes the same ``batches(batch_size, rng=…, pad_to=…)`` iterator
+contract as :class:`roko_tpu.training.data.InMemoryDataset`, so the
+train loop treats the two interchangeably (``TrainConfig.in_memory``).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Tuple
+
+import h5py
+import numpy as np
+
+from roko_tpu.data.hdf5 import data_group_names, hdf5_files
+
+
+class StreamingDataset:
+    """Lazily streams (examples, labels) from one or more HDF5 files."""
+
+    def __init__(self, path: str, chunk_size: int = 256, buffer_chunks: int = 16):
+        self.files = hdf5_files(path)
+        self.chunk_size = chunk_size
+        self.buffer_chunks = buffer_chunks
+        #: (file_idx, group_name, start, count) per chunk
+        self._chunks: List[Tuple[int, str, int, int]] = []
+        self._len = 0
+        for fi, filename in enumerate(self.files):
+            with h5py.File(filename, "r") as fd:
+                for g in data_group_names(fd):
+                    n = fd[g]["examples"].shape[0]
+                    if "labels" not in fd[g]:
+                        raise ValueError(f"{filename}:{g} has no labels")
+                    self._len += n
+                    for start in range(0, n, chunk_size):
+                        count = min(chunk_size, n - start)
+                        self._chunks.append((fi, g, start, count))
+        if not self._chunks:
+            raise ValueError(f"no training groups found under {path}")
+
+    def __len__(self) -> int:
+        return self._len
+
+    def _iter_chunks(
+        self, rng: Optional[np.random.Generator]
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        order = np.arange(len(self._chunks))
+        if rng is not None:
+            rng.shuffle(order)
+        fds: dict = {}
+        try:
+            for ci in order:
+                fi, g, start, count = self._chunks[ci]
+                fd = fds.get(fi)
+                if fd is None:
+                    fd = fds[fi] = h5py.File(self.files[fi], "r")
+                x = fd[g]["examples"][start : start + count]
+                y = fd[g]["labels"][start : start + count]
+                yield np.asarray(x, np.uint8), np.asarray(y, np.int32)
+        finally:
+            for fd in fds.values():
+                fd.close()
+
+    def batches(
+        self,
+        batch_size: int,
+        *,
+        rng: Optional[np.random.Generator] = None,
+        drop_remainder: bool = False,
+        pad_to: Optional[int] = None,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Same contract as InMemoryDataset.batches: yields (x, y, w)."""
+        buf_x: List[np.ndarray] = []
+        buf_y: List[np.ndarray] = []
+        held = 0
+
+        def drain(final: bool):
+            nonlocal buf_x, buf_y, held
+            x = np.concatenate(buf_x)
+            y = np.concatenate(buf_y)
+            if rng is not None:  # shuffle inside the buffer
+                perm = rng.permutation(len(x))
+                x, y = x[perm], y[perm]
+            n_keep = len(x) if final else (len(x) // batch_size) * batch_size
+            for s in range(0, n_keep, batch_size):
+                xb = x[s : s + batch_size]
+                yb = y[s : s + batch_size]
+                if len(xb) < batch_size:
+                    if drop_remainder:
+                        break
+                    if pad_to is not None:
+                        pad = pad_to - len(xb)
+                        w = np.concatenate(
+                            [np.ones(len(xb), np.float32), np.zeros(pad, np.float32)]
+                        )
+                        xb = np.concatenate(
+                            [xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)]
+                        )
+                        yb = np.concatenate(
+                            [yb, np.zeros((pad,) + yb.shape[1:], yb.dtype)]
+                        )
+                        yield xb, yb, w
+                        break
+                yield xb, yb, np.ones(len(xb), np.float32)
+            leftovers = x[n_keep:], y[n_keep:]
+            buf_x = [leftovers[0]] if len(leftovers[0]) else []
+            buf_y = [leftovers[1]] if len(leftovers[1]) else []
+            held = len(leftovers[0])
+
+        for x, y in self._iter_chunks(rng):
+            buf_x.append(x)
+            buf_y.append(y)
+            held += len(x)
+            if held >= self.buffer_chunks * self.chunk_size:
+                yield from drain(final=False)
+        if held:
+            yield from drain(final=True)

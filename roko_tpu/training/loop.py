@@ -36,6 +36,7 @@ from roko_tpu.parallel.mesh import (
 )
 from roko_tpu.training import checkpoint as ckpt_lib
 from roko_tpu.training.data import InMemoryDataset, prefetch_to_device
+from roko_tpu.utils.profiling import device_trace
 
 Params = Dict[str, Any]
 
@@ -155,6 +156,7 @@ def train(
     *,
     mesh: Optional[Mesh] = None,
     resume: bool = True,
+    trace_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
 ) -> TrainState:
     """Full training run; returns the final state. Best-k checkpoints by
@@ -172,7 +174,12 @@ def train(
             f"batch_size {tcfg.batch_size} not divisible by dp={dp}"
         )
 
-    train_ds = InMemoryDataset.from_path(train_path)
+    if tcfg.in_memory:
+        train_ds = InMemoryDataset.from_path(train_path)
+    else:  # out-of-core streaming (ref lazy TrainDataset, SURVEY §2.7)
+        from roko_tpu.training.lazy_data import StreamingDataset
+
+        train_ds = StreamingDataset(train_path)
     val_ds = InMemoryDataset.from_path(val_path) if val_path else None
     log(
         f"train windows: {len(train_ds)}"
@@ -201,7 +208,6 @@ def train(
 
     manager = ckpt_lib.CheckpointManager(out_dir, keep=tcfg.keep_checkpoints)
     best_acc, bad_epochs = -1.0, 0
-    np_rng = np.random.default_rng(tcfg.seed)
     params, opt_state, step_no = state.params, state.opt_state, state.step
 
     # the saved state carries the epoch explicitly — deriving it from
@@ -210,7 +216,20 @@ def train(
     ckpt_like = dict(state.as_dict(), epoch=jnp.zeros((), jnp.int32))
     start_epoch = 0
     if resume:
-        restored = manager.restore_latest(like=ckpt_like)
+        try:
+            restored = manager.restore_latest(like=ckpt_like)
+        except Exception:
+            # pre-'epoch' checkpoint layout: restore the old structure
+            # and recover the epoch from the step count
+            restored = manager.restore_latest(like=state.as_dict())
+            if restored is not None:
+                steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
+                restored = dict(
+                    restored,
+                    epoch=jnp.asarray(
+                        int(restored["step"]) // steps_per_epoch - 1, jnp.int32
+                    ),
+                )
         if restored is not None:
             params = jax.device_put(restored["params"], repl)
             opt_state = jax.device_put(restored["opt_state"], repl)
@@ -220,13 +239,16 @@ def train(
                 f"resumed from step {int(jax.device_get(step_no))} "
                 f"(epoch {start_epoch})"
             )
-            # keep the host RNG stream aligned with the completed epochs
-            for _ in range(start_epoch):
-                np_rng.permutation(len(train_ds))
 
     try:
         for epoch in range(start_epoch, tcfg.epochs):
             t0 = time.perf_counter()
+            # per-epoch derived RNG: epoch E shuffles identically whether
+            # or not the run was interrupted before it, for both the
+            # in-memory and streaming datasets (no replay bookkeeping)
+            np_rng = np.random.default_rng(
+                np.random.SeedSequence([tcfg.seed, epoch])
+            )
             # pad the trailing batch (zero-weight rows) instead of dropping
             # it: fixed shapes for XLA, but every window trains (the
             # reference's DataLoader also kept the last partial batch)
@@ -237,14 +259,18 @@ def train(
             # dispatch never blocks on a per-step float()
             running = jnp.zeros((), jnp.float32)
             n_batches = 0
-            for x, y, w in prefetch_to_device(batches, tcfg.prefetch, place):
-                params, opt_state, loss, _ = train_step(
-                    params, opt_state, step_no, x, y, w, dropout_rng
-                )
-                step_no = step_no + 1
-                running = running + loss
-                n_batches += 1
-            running = float(jax.device_get(running))
+            # trace only the first trained epoch: a bounded window keeps
+            # the profile loadable; a whole run would buffer every event
+            trace = device_trace(trace_dir if epoch == start_epoch else None)
+            with trace:
+                for x, y, w in prefetch_to_device(batches, tcfg.prefetch, place):
+                    params, opt_state, loss, _ = train_step(
+                        params, opt_state, step_no, x, y, w, dropout_rng
+                    )
+                    step_no = step_no + 1
+                    running = running + loss
+                    n_batches += 1
+                running = float(jax.device_get(running))
             dt = time.perf_counter() - t0
 
             eval_ds = val_ds if val_ds is not None else train_ds
