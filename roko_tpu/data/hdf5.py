@@ -27,6 +27,10 @@ import numpy as np
 
 
 class _ContigBuffer:
+    """Holds stacked [N, ...] blocks per contig; ``write`` concatenates.
+    Block granularity (one block per worker region) keeps multiprocess
+    IPC to two contiguous buffers per region."""
+
     def __init__(self, name: str, infer: bool):
         self.name = name
         self.infer = infer
@@ -35,20 +39,26 @@ class _ContigBuffer:
         self.Y: List[np.ndarray] = []
 
     def extend(self, pos, X, Y) -> None:
+        # accepts stacked [N,...] arrays or lists of per-window arrays
+        pos = np.asarray(pos, dtype=np.int64)
+        X = np.asarray(X, dtype=np.uint8)
+        if len(pos) == 0:
+            return
         if self.infer:
             assert len(pos) == len(X)
         else:
-            assert Y is not None and len(pos) == len(X) == len(Y)
-        self.pos.extend(np.asarray(p, dtype=np.int64) for p in pos)
-        self.X.extend(np.asarray(x, dtype=np.uint8) for x in X)
-        if not self.infer:
-            self.Y.extend(np.asarray(y, dtype=np.int64) for y in Y)
+            assert Y is not None
+            Y = np.asarray(Y, dtype=np.int64)
+            assert len(pos) == len(X) == len(Y)
+            self.Y.append(Y)
+        self.pos.append(pos)
+        self.X.append(X)
 
     def write(self, fd: h5py.File) -> None:
         if not self.pos:
             return
-        start = int(self.pos[0][0][0])
-        end = int(self.pos[-1][-1][0])
+        start = int(self.pos[0][0, 0, 0])
+        end = int(self.pos[-1][-1, -1, 0])
         base = f"{self.name}_{start}-{end}"
         group_name, k = base, 0
         while group_name in fd:
@@ -56,12 +66,13 @@ class _ContigBuffer:
             group_name = f"{base}.{k}"
 
         group = fd.create_group(group_name)
-        group["positions"] = np.stack(self.pos)
+        positions = np.concatenate(self.pos)
+        group["positions"] = positions
         if not self.infer:
-            group["labels"] = np.stack(self.Y)
+            group["labels"] = np.concatenate(self.Y)
         group.attrs["contig"] = self.name
-        group.attrs["size"] = len(self.pos)
-        X = np.stack(self.X)
+        group.attrs["size"] = len(positions)
+        X = np.concatenate(self.X)
         group.create_dataset("examples", data=X, chunks=(1,) + X.shape[1:])
 
         self.pos.clear()

@@ -41,3 +41,37 @@ def extract_region_windows(
         return list(
             extract_windows(reader, contig, start, end, seed, window_cfg, filter_cfg)
         )
+
+
+def extract_region_arrays(
+    bam_path: str,
+    contig: str,
+    start: int,
+    end: int,
+    seed: int,
+    window_cfg: WindowConfig,
+    filter_cfg: ReadFilterConfig,
+):
+    """Stacked form: (positions int64[N,cols,2], matrix uint8[N,rows,cols]).
+    Preferred by the multiprocess pipeline — two contiguous buffers per
+    region pickle ~100x faster than N per-window arrays."""
+    if _native_available():
+        from roko_tpu.native import binding
+
+        return binding.extract_windows_arrays(
+            bam_path, contig, start, end, seed, window_cfg, filter_cfg
+        )
+    import numpy as np
+
+    windows = extract_region_windows(
+        bam_path, contig, start, end, seed, window_cfg, filter_cfg
+    )
+    if not windows:
+        return (
+            np.empty((0, window_cfg.cols, 2), np.int64),
+            np.empty((0, window_cfg.rows, window_cfg.cols), np.uint8),
+        )
+    return (
+        np.stack([w.positions for w in windows]),
+        np.stack([w.matrix for w in windows]),
+    )

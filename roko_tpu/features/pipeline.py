@@ -23,7 +23,10 @@ from roko_tpu import constants as C
 from roko_tpu.config import RegionConfig, RokoConfig
 from roko_tpu.data.hdf5 import DataWriter
 from roko_tpu.features import labels as L
-from roko_tpu.features.backend import extract_region_windows
+from roko_tpu.features.backend import (
+    extract_region_arrays,
+    extract_region_windows,
+)
 from roko_tpu.features.labels import Region
 from roko_tpu.io.bam import BamReader
 from roko_tpu.io.fasta import read_fasta
@@ -57,11 +60,20 @@ def _is_in_region(pos: int, aligns: Sequence[L.TargetAlign]) -> bool:
     return any(a.start <= pos < a.end for a in aligns)
 
 
+def _empty_arrays(config: RokoConfig):
+    w = config.window
+    return (
+        np.empty((0, w.cols, 2), np.int64),
+        np.empty((0, w.rows, w.cols), np.uint8),
+    )
+
+
 def generate_infer(job: _Job):
     """Feature windows for one region, inference mode
-    (ref: roko/features.py:97-110)."""
+    (ref: roko/features.py:97-110). Returns stacked arrays — two
+    contiguous buffers cross the worker boundary, not N small ones."""
     region = job.region
-    windows = extract_region_windows(
+    positions, examples = extract_region_arrays(
         job.bam_x,
         region.name,
         region.start,
@@ -70,8 +82,6 @@ def generate_infer(job: _Job):
         job.config.window,
         job.config.read_filter,
     )
-    positions = [w.positions for w in windows]
-    examples = [w.matrix for w in windows]
     return region.name, positions, examples, None
 
 
@@ -147,7 +157,14 @@ def generate_train(job: _Job):
                 examples.append(w.matrix)
                 labels.append(np.asarray(Y, dtype=np.int64))
 
-    return region.name, positions, examples, labels
+    if not positions:
+        return region.name, *_empty_arrays(job.config), np.empty((0, job.config.window.cols), np.int64)
+    return (
+        region.name,
+        np.stack(positions),
+        np.stack(examples),
+        np.stack(labels),
+    )
 
 
 def run_features(
@@ -188,7 +205,17 @@ def run_features(
             results = map(func, jobs)
             pool = None
         else:
-            pool = multiprocessing.Pool(processes=workers)
+            from roko_tpu.features.backend import _native_available
+
+            if _native_available():
+                # the C++ extractor releases the GIL, so threads give
+                # full parallelism with zero IPC (results stay in-process
+                # — no pickling of the window buffers)
+                from multiprocessing.pool import ThreadPool
+
+                pool = ThreadPool(processes=workers)
+            else:
+                pool = multiprocessing.Pool(processes=workers)
             results = pool.imap(func, jobs)
 
         try:

@@ -80,7 +80,7 @@ def is_available() -> bool:
         return False
 
 
-def extract_windows(
+def extract_windows_arrays(
     bam_path: str,
     contig: str,
     start: int,
@@ -88,9 +88,11 @@ def extract_windows(
     seed: int,
     window_cfg: Optional[WindowConfig] = None,
     filter_cfg: Optional[ReadFilterConfig] = None,
-) -> List[Window]:
-    """Native equivalent of roko_tpu.features.extract.extract_windows;
-    bit-identical output (tests/test_native.py)."""
+):
+    """Stacked form: (positions int64[N,cols,2], matrix uint8[N,rows,cols]).
+    The preferred interface — the multiprocess pipeline ships these two
+    contiguous buffers per region across the worker boundary instead of
+    thousands of per-window arrays."""
     wcfg = window_cfg or WindowConfig()
     fcfg = filter_cfg or ReadFilterConfig()
     lib = _load()
@@ -116,11 +118,32 @@ def extract_windows(
     try:
         n = int(res.n_windows)
         if n == 0:
-            return []
-        pos = np.ctypeslib.as_array(res.positions, shape=(n, wcfg.cols, 2)).copy()
-        mat = np.ctypeslib.as_array(
-            res.matrix, shape=(n, wcfg.rows, wcfg.cols)
-        ).copy()
+            pos = np.empty((0, wcfg.cols, 2), np.int64)
+            mat = np.empty((0, wcfg.rows, wcfg.cols), np.uint8)
+        else:
+            pos = np.ctypeslib.as_array(res.positions, shape=(n, wcfg.cols, 2)).copy()
+            mat = np.ctypeslib.as_array(
+                res.matrix, shape=(n, wcfg.rows, wcfg.cols)
+            ).copy()
     finally:
         lib.roko_free_result(ctypes.byref(res))
-    return [Window(positions=pos[i], matrix=mat[i]) for i in range(n)]
+    return pos, mat
+
+
+def extract_windows(
+    bam_path: str,
+    contig: str,
+    start: int,
+    end: int,
+    seed: int,
+    window_cfg: Optional[WindowConfig] = None,
+    filter_cfg: Optional[ReadFilterConfig] = None,
+) -> List[Window]:
+    """Native equivalent of roko_tpu.features.extract.extract_windows;
+    bit-identical output (tests/test_native.py)."""
+    pos, mat = extract_windows_arrays(
+        bam_path, contig, start, end, seed, window_cfg, filter_cfg
+    )
+    return [
+        Window(positions=pos[i], matrix=mat[i]) for i in range(pos.shape[0])
+    ]
