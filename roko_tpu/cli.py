@@ -196,8 +196,22 @@ def build_parser() -> argparse.ArgumentParser:
     return parser
 
 
+def _honor_jax_platforms_env() -> None:
+    """Make ``JAX_PLATFORMS`` authoritative even when a sitecustomize hook
+    already imported jax and registered a different backend (TPU-VM images
+    do this), in which case the env var alone is silently ignored."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want and "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    _honor_jax_platforms_env()
     return args.fn(args)
 
 
