@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from roko_tpu.models.layers import dropout as _dropout
+
 
 def gru_layer_params(
     rng: jax.Array, in_size: int, hidden: int, dtype=jnp.float32
@@ -93,9 +95,7 @@ def bidir_gru_stack(
         if dropout > 0.0 and not deterministic and i < num_layers - 1:
             assert rng is not None
             rng, sub = jax.random.split(rng)
-            keep = 1.0 - dropout
-            mask = jax.random.bernoulli(sub, keep, x.shape)
-            x = jnp.where(mask, x / keep, 0.0)
+            x = _dropout(sub, x, dropout)
     return x
 
 
@@ -130,15 +130,23 @@ class RokoGRU:
         return tuple(layers)
 
     def apply(self, params, x, *, deterministic=True, rng=None):
-        # The fused Pallas kernel is inference-only (no dropout and no
-        # custom VJP); training always takes the lax.scan path. Off-TPU
-        # the flag is ignored too — interpret-mode Pallas is orders of
-        # magnitude slower than the numerically-identical scan, and
-        # use_pallas can ride along in checkpointed configs.
-        if self.use_pallas and deterministic and jax.default_backend() == "tpu":
+        # The fused Pallas kernels cover both inference and training
+        # (custom VJP recomputes the gates backward; dropout lives
+        # between layers, outside the kernels). Off-TPU the flag is
+        # ignored — interpret-mode Pallas is orders of magnitude slower
+        # than the numerically-identical scan, and use_pallas can ride
+        # along in checkpointed configs.
+        if self.use_pallas and jax.default_backend() == "tpu":
             from roko_tpu.models.pallas_gru import bidir_gru_stack_pallas
 
-            return bidir_gru_stack_pallas(params, x, compute_dtype=x.dtype)
+            return bidir_gru_stack_pallas(
+                params,
+                x,
+                dropout=self.dropout,
+                deterministic=deterministic,
+                rng=rng,
+                compute_dtype=x.dtype,
+            )
         return bidir_gru_stack(
             params,
             x,

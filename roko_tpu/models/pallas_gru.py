@@ -1,54 +1,103 @@
-"""Fused Pallas TPU kernel for the GRU recurrence.
+"""Fused Pallas TPU kernels for the GRU recurrence — forward AND backward.
 
 The hot loop of the consensus model is 90 timesteps x 2 directions x 3
-layers of GRU steps (SURVEY.md §7 "hard parts" (a)). The lax.scan path
-re-materialises the hidden state through HBM every step; this kernel
-runs one whole direction's recurrence inside a single Pallas program
-with the hidden state pinned in a VMEM scratch buffer, so the serial
-chain touches HBM only for the per-step x-projection read and output
-write.
+layers of GRU steps (SURVEY.md §7 "hard parts" (a); semantics anchor:
+the reference's 3-layer bidirectional ``torch.nn.GRU``,
+roko/rnn_model.py:40-41). The lax.scan path re-materialises the hidden
+state through HBM every step; these kernels run the whole serial chain
+inside Pallas programs with the hidden state pinned in VMEM scratch.
 
-Layout choices:
-- the input projection ``x @ W_ih + b_ih`` stays OUTSIDE the kernel —
-  one large [B*T, in] x [in, 3H] MXU matmul that XLA already schedules
-  well (same hoisting as the scan path, roko_tpu/models/gru.py:11-14);
-- time-major [T, B, 3H] so the serial loop indexes the leading axis;
-- x_proj is cast to the model compute dtype for the VMEM residency
-  (bfloat16 halves VMEM pressure: [90,128,384] bf16 = 8.8 MB); the
-  recurrence itself accumulates in float32;
-- H=128 keeps every matmul lane-aligned (MXU 128x128).
+Design (v2 — single launch per layer, train-capable):
 
-The kernel is inference-only: training keeps the lax.scan path (whose
-VJP XLA derives automatically). ``interpret=True`` makes the same
-kernel run on CPU for tests.
+- **Directions fused into one launch.** Both directions of a layer run
+  in one ``pallas_call`` with grid ``(S, nb, nt)``: direction, batch
+  block, time block. The backward direction's inputs are time-reversed
+  on the host side so the kernel always recurs forward in kernel time;
+  per-direction weights are selected by the direction grid index. One
+  launch per layer instead of two (3 per forward instead of 6).
+- **Time-blocked streaming.** The grid's innermost axis walks time
+  blocks while the hidden state carries across iterations in VMEM
+  scratch (the TPU grid is sequential, scratch persists). Pallas
+  double-buffers the next time block's DMA behind the current block's
+  compute, so VMEM holds only ``2 x t_blk`` slabs instead of all T —
+  which is what lets the batch block widen to 128-256 rows and fill the
+  128x128 MXU (the previous kernel's whole-T residency capped blocks at
+  64 rows, half the MXU).
+- **Input projection stays outside.** ``x @ W_ih + b_ih`` for all
+  timesteps and both directions is one large MXU matmul XLA already
+  schedules well (same hoisting as the scan path, models/gru.py:11-14).
+- **Backward kernel** (``custom_vjp``): recomputes the gates from the
+  stored per-step hidden states (no activation stash beyond the layer
+  output the caller keeps anyway), accumulates ``dW_hh``/``db_hh`` in
+  VMEM across batch/time blocks, and streams ``dx_proj`` out; the
+  weight-gradient matmuls for ``W_ih`` happen outside as one big GEMM.
+  This makes ``use_pallas=True`` train-capable (round-1 gap).
+
+Numerics: the recurrence accumulates the hidden state in float32; in
+bfloat16 compute mode the per-step matmul runs bf16 x bf16 -> f32 (the
+MXU fast path) and stored states/outputs are bf16. ``interpret=True``
+runs the same kernels on CPU for tests.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from roko_tpu.models.layers import dropout as _dropout
 
-def _gru_kernel(T: int, hidden: int, reverse: bool, out_dtype):
+# VMEM working-set budget per kernel invocation (double-buffered blocks
+# included). The guide's figure is ~16 MB/core; stay under it.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _pick_blocks(T: int, B: int, hidden: int, itemsize: int, bwd: bool):
+    """Choose (t_blk, b_blk): batch rows first (MXU fill), then the
+    largest divisor-of-T time block that fits the VMEM budget.
+
+    b_blk targets 256 rows (two full MXU row-tiles) but is shrunk to the
+    evenest 16-row-aligned split so per-block padding never exceeds 15
+    rows (up to 15*nb dead rows across a multi-block batch) — naively
+    capping at 256 would recur up to 255 dead rows for batches just over
+    a block multiple."""
+    nb = -(-B // 256)
+    b_blk = min(256, _round_up(-(-B // nb), 16))
+    divisors = [d for d in range(T, 0, -1) if T % d == 0]
+    # bytes per (time, batch-row): fwd streams x_proj[3H] + out[H]; bwd
+    # streams x_proj[3H] + h[H] + dy[H] + dx_proj[3H] + a 1-row h_prev
+    # boundary block (counted as one extra H for slack).
+    per_row = (9 if bwd else 4) * hidden * itemsize
+    # 2x for double buffering
+    for t_blk in divisors:
+        if 2 * t_blk * b_blk * per_row <= _VMEM_BUDGET:
+            return t_blk, b_blk
+    return 1, b_blk
+
+
+def _fwd_kernel(t_blk: int, hidden: int, cdt, out_dtype):
     def kernel(xp_ref, whh_ref, bhh_ref, out_ref, h_scratch):
-        h_scratch[...] = jnp.zeros_like(h_scratch)
+        @pl.when(pl.program_id(2) == 0)
+        def _init():
+            h_scratch[...] = jnp.zeros_like(h_scratch)
 
-        def step(i, _):
-            t = (T - 1 - i) if reverse else i
-            xp = xp_ref[t].astype(jnp.float32)  # [B, 3H]
-            h = h_scratch[...]
+        whh = whh_ref[0]  # [H, 3H]
+        bhh = bhh_ref[...].astype(jnp.float32)  # [1, 3H], broadcasts
+
+        def step(j, h):
+            xp = xp_ref[j].astype(jnp.float32)  # [b_blk, 3H]
             hp = (
-                jnp.dot(
-                    h,
-                    whh_ref[...].astype(jnp.float32),
-                    preferred_element_type=jnp.float32,
-                )
-                + bhh_ref[...].astype(jnp.float32)
+                jnp.dot(h.astype(cdt), whh, preferred_element_type=jnp.float32)
+                + bhh
             )
             r = jax.nn.sigmoid(xp[:, :hidden] + hp[:, :hidden])
             z = jax.nn.sigmoid(
@@ -56,18 +105,277 @@ def _gru_kernel(T: int, hidden: int, reverse: bool, out_dtype):
             )
             n = jnp.tanh(xp[:, 2 * hidden :] + r * hp[:, 2 * hidden :])
             h_new = (1.0 - z) * n + z * h
-            h_scratch[...] = h_new
-            out_ref[t] = h_new.astype(out_dtype)
-            return 0
+            out_ref[j] = h_new.astype(out_dtype)
+            return h_new
 
-        jax.lax.fori_loop(0, T, step, 0)
+        h_scratch[...] = lax.fori_loop(0, t_blk, step, h_scratch[...])
 
     return kernel
 
 
-@functools.partial(
-    jax.jit, static_argnames=("reverse", "interpret", "compute_dtype")
-)
+def _bwd_kernel(t_blk: int, nt: int, hidden: int, cdt, dxp_dtype):
+    """Reverse-time sweep: recompute gates from stored states, emit
+    dx_proj, accumulate dW_hh/db_hh in VMEM output blocks (revisited
+    across the inner grid axes), carry dh in scratch."""
+
+    def kernel(
+        xp_ref, h_ref, hprev_ref, dy_ref, whh_ref, bhh_ref,
+        dxp_ref, dwhh_ref, dbhh_ref, dh_scratch,
+    ):
+        i, k = pl.program_id(1), pl.program_id(2)
+
+        @pl.when(k == 0)
+        def _init_dh():
+            dh_scratch[...] = jnp.zeros_like(dh_scratch)
+
+        @pl.when((i == 0) & (k == 0))
+        def _init_acc():
+            dwhh_ref[...] = jnp.zeros(dwhh_ref.shape, dwhh_ref.dtype)
+            dbhh_ref[...] = jnp.zeros(dbhh_ref.shape, dbhh_ref.dtype)
+
+        whh = whh_ref[0]  # [H, 3H]
+        bhh = bhh_ref[...].astype(jnp.float32)  # [1, 3H], broadcasts
+        first_time_block = k == nt - 1  # time blocks walked in reverse
+
+        def step(jj, carry):
+            dh, dwhh, dbhh = carry
+            j = t_blk - 1 - jj
+            xp = xp_ref[j].astype(jnp.float32)
+            # h_{t-1}: previous row of this block, or the last row of
+            # the previous time block, or zeros at t == 0
+            h_in_blk = h_ref[jnp.maximum(j - 1, 0)].astype(jnp.float32)
+            h_boundary = hprev_ref[0].astype(jnp.float32)
+            at_t0 = first_time_block & (j == 0)
+            h_prev = jnp.where(
+                j > 0,
+                h_in_blk,
+                jnp.where(at_t0, jnp.zeros_like(h_boundary), h_boundary),
+            )
+            hp = (
+                jnp.dot(
+                    h_prev.astype(cdt), whh, preferred_element_type=jnp.float32
+                )
+                + bhh
+            )
+            r = jax.nn.sigmoid(xp[:, :hidden] + hp[:, :hidden])
+            z = jax.nn.sigmoid(
+                xp[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden]
+            )
+            hpn = hp[:, 2 * hidden :]
+            n = jnp.tanh(xp[:, 2 * hidden :] + r * hpn)
+
+            dh = dh + dy_ref[j].astype(jnp.float32)
+            dz = dh * (h_prev - n) * z * (1.0 - z)
+            dn_pre = dh * (1.0 - z) * (1.0 - n * n)
+            dr_pre = dn_pre * hpn * r * (1.0 - r)
+            da = jnp.concatenate([dr_pre, dz, dn_pre], axis=1)  # dx_proj
+            dhp = jnp.concatenate([dr_pre, dz, dn_pre * r], axis=1)
+            dxp_ref[j] = da.astype(dxp_dtype)
+            dh_next = dh * z + jnp.dot(
+                dhp.astype(cdt), whh.T, preferred_element_type=jnp.float32
+            )
+            dwhh = dwhh + jnp.dot(
+                h_prev.astype(cdt).T,
+                dhp.astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            dbhh = dbhh + dhp.sum(axis=0, keepdims=True)
+            return dh_next, dwhh, dbhh
+
+        dh0 = dh_scratch[...]
+        dwhh0 = dwhh_ref[0]
+        dbhh0 = dbhh_ref[...]  # [1, 3H]
+        dh, dwhh, dbhh = lax.fori_loop(0, t_blk, step, (dh0, dwhh0, dbhh0))
+        dh_scratch[...] = dh
+        dwhh_ref[0] = dwhh
+        dbhh_ref[...] = dbhh
+
+    return kernel
+
+
+def _stack_dirs(
+    arrs: Sequence[jax.Array], flags: Sequence[bool], Bp: int
+) -> jax.Array:
+    """[B,T,F] per direction -> time-major [T, S*Bp, F] with reversed
+    directions flipped into kernel time and batch padded per direction."""
+    B = arrs[0].shape[0]
+    out = []
+    for a, rev in zip(arrs, flags):
+        if rev:
+            a = jnp.flip(a, axis=1)
+        if Bp != B:
+            a = jnp.concatenate(
+                [a, jnp.zeros((Bp - B,) + a.shape[1:], a.dtype)], axis=0
+            )
+        out.append(a.swapaxes(0, 1))  # [T, Bp, F]
+    return jnp.concatenate(out, axis=1)  # [T, S*Bp, F]
+
+
+def _unstack_dirs(
+    stacked: jax.Array, flags: Sequence[bool], B: int, Bp: int
+) -> Tuple[jax.Array, ...]:
+    """Inverse of ``_stack_dirs``: [T, S*Bp, F] -> per-direction [B,T,F]."""
+    out = []
+    for s, rev in enumerate(flags):
+        a = stacked[:, s * Bp : s * Bp + B].swapaxes(0, 1)  # [B,T,F]
+        if rev:
+            a = jnp.flip(a, axis=1)
+        out.append(a)
+    return tuple(out)
+
+
+# static = (flags tuple, interpret, compute_dtype name)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _gru_multi(static, w_ih, b_ih, w_hh, b_hh, x):
+    """S stacked GRU directions over shared input ``x`` [B,T,in].
+
+    ``w_ih`` [S,in,3H], ``b_ih`` [S,3H], ``w_hh`` [S,H,3H], ``b_hh``
+    [S,3H]; returns ``ys`` [S,B,T,H] in natural time order.
+    """
+    ys, _ = _gru_multi_fwd(static, w_ih, b_ih, w_hh, b_hh, x)
+    return ys
+
+
+def _xproj_stacked(static, w_ih, b_ih, x, Bp):
+    flags, _, cdt_name = static
+    S = len(flags)
+    B, T, _ = x.shape
+    H3 = w_ih.shape[2]
+    cdt = jnp.dtype(cdt_name)
+    # one [B*T, in] x [in, S*3H] MXU matmul for all directions
+    w_cat = jnp.transpose(w_ih, (1, 0, 2)).reshape(w_ih.shape[1], S * H3)
+    xp = x @ w_cat + b_ih.reshape(1, 1, S * H3)
+    per_dir = [xp[..., s * H3 : (s + 1) * H3] for s in range(S)]
+    return _stack_dirs(per_dir, flags, Bp).astype(cdt)  # [T, S*Bp, 3H]
+
+
+def _gru_multi_fwd(static, w_ih, b_ih, w_hh, b_hh, x):
+    flags, interpret, cdt_name = static
+    S = len(flags)
+    B, T, _ = x.shape
+    hidden = w_hh.shape[1]
+    cdt = jnp.dtype(cdt_name)
+
+    t_blk, b_blk = _pick_blocks(T, B, hidden, cdt.itemsize, bwd=False)
+    Bp = _round_up(B, b_blk)
+    nb, nt = Bp // b_blk, T // t_blk
+
+    xs = _xproj_stacked(static, w_ih, b_ih, x, Bp)
+    hs = pl.pallas_call(
+        _fwd_kernel(t_blk, hidden, cdt, cdt),
+        grid=(S, nb, nt),
+        out_shape=jax.ShapeDtypeStruct((T, S * Bp, hidden), cdt),
+        in_specs=[
+            pl.BlockSpec((t_blk, b_blk, 3 * hidden),
+                         lambda s, i, k: (k, s * nb + i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hidden, 3 * hidden), lambda s, i, k: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * hidden), lambda s, i, k: (s, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((t_blk, b_blk, hidden),
+                               lambda s, i, k: (k, s * nb + i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((b_blk, hidden), jnp.float32)],
+        interpret=interpret,
+    )(xs, w_hh.astype(cdt), b_hh)
+
+    per_dir = _unstack_dirs(hs, flags, B, Bp)
+    ys = jnp.stack(per_dir, axis=0)  # [S,B,T,H]
+    return ys, (w_ih, b_ih, w_hh, b_hh, x, ys)
+
+
+def _gru_multi_bwd(static, res, dys):
+    flags, interpret, cdt_name = static
+    w_ih, b_ih, w_hh, b_hh, x, ys = res
+    S = len(flags)
+    B, T, _ = x.shape
+    hidden = w_hh.shape[1]
+    cdt = jnp.dtype(cdt_name)
+
+    t_blk, b_blk = _pick_blocks(T, B, hidden, cdt.itemsize, bwd=True)
+    Bp = _round_up(B, b_blk)
+    nb, nt = Bp // b_blk, T // t_blk
+
+    xs = _xproj_stacked(static, w_ih, b_ih, x, Bp)
+    hs = _stack_dirs(list(ys.astype(cdt)), flags, Bp)
+    dy = _stack_dirs(list(dys.astype(cdt)), flags, Bp)
+    # one boundary row per time block (h at the block's last step): the
+    # kernel needs h_{t-1} across block edges but only ONE row of the
+    # previous block — streaming the whole block again would double the
+    # h-stream HBM traffic
+    hs_bound = hs[t_blk - 1 :: t_blk]  # [nt, S*Bp, H]
+
+    # time blocks are walked newest-first; hprev is the boundary row one
+    # time block earlier (clamped at the start; the kernel masks t == 0)
+    def tmap(s, i, k):
+        return (nt - 1 - k, s * nb + i, 0)
+
+    def tmap_prev(s, i, k):
+        return (jnp.maximum(nt - 1 - k - 1, 0), s * nb + i, 0)
+
+    dxp, dwhh, dbhh = pl.pallas_call(
+        _bwd_kernel(t_blk, nt, hidden, cdt, cdt),
+        grid=(S, nb, nt),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, S * Bp, 3 * hidden), cdt),
+            jax.ShapeDtypeStruct((S, hidden, 3 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((S, 3 * hidden), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((t_blk, b_blk, 3 * hidden), tmap,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t_blk, b_blk, hidden), tmap,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, b_blk, hidden), tmap_prev,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t_blk, b_blk, hidden), tmap,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hidden, 3 * hidden), lambda s, i, k: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * hidden), lambda s, i, k: (s, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((t_blk, b_blk, 3 * hidden), tmap,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hidden, 3 * hidden), lambda s, i, k: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 3 * hidden), lambda s, i, k: (s, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[pltpu.VMEM((b_blk, hidden), jnp.float32)],
+        interpret=interpret,
+    )(xs, hs, hs_bound, dy, w_hh.astype(cdt), b_hh)
+
+    dxp_dirs = _unstack_dirs(dxp, flags, B, Bp)  # S x [B,T,3H]
+    dxp_all = jnp.stack(dxp_dirs, axis=0).astype(jnp.float32)  # [S,B,T,3H]
+    x32 = x.astype(jnp.float32)
+    # dx = sum_s dxp_s @ w_ih_s^T ; dw_ih_s = x^T dxp_s — big MXU GEMMs
+    dx = jnp.einsum("sbtn,sin->bti", dxp_all, w_ih.astype(jnp.float32))
+    dw_ih = jnp.einsum("bti,sbtn->sin", x32, dxp_all)
+    db_ih = dxp_all.sum(axis=(1, 2))
+    return (
+        dw_ih.astype(w_ih.dtype),
+        db_ih.astype(b_ih.dtype),
+        dwhh.astype(w_hh.dtype),
+        dbhh.astype(b_hh.dtype),
+        dx.astype(x.dtype),
+    )
+
+
+_gru_multi.defvjp(_gru_multi_fwd, _gru_multi_bwd)
+
+
+def _dir_arrays(params_list):
+    w_ih = jnp.stack([p["w_ih"] for p in params_list])
+    b_ih = jnp.stack([p["b_ih"] for p in params_list])
+    w_hh = jnp.stack([p["w_hh"] for p in params_list])
+    b_hh = jnp.stack([p["b_hh"] for p in params_list])
+    return w_ih, b_ih, w_hh, b_hh
+
+
 def gru_direction_pallas(
     params: Dict[str, jax.Array],
     x: jax.Array,  # [B, T, in]
@@ -78,68 +386,47 @@ def gru_direction_pallas(
 ) -> jax.Array:
     """One direction of one GRU layer, [B,T,in] -> [B,T,H]; numerics
     match roko_tpu.models.gru.gru_direction (same gate math, float32
-    accumulation)."""
-    hidden = params["w_hh"].shape[0]
-    B, T, _ = x.shape
+    hidden accumulation). Differentiable via the fused backward kernel."""
+    static = ((bool(reverse),), bool(interpret), jnp.dtype(compute_dtype).name)
+    ys = _gru_multi(static, *_dir_arrays([params]), x)
+    return ys[0]
 
-    x_proj = x @ params["w_ih"] + params["b_ih"]  # [B,T,3H] big MXU matmul
-    x_proj = x_proj.swapaxes(0, 1).astype(compute_dtype)  # [T,B,3H]
 
-    # batch-block the grid so x_proj residency stays within VMEM: Pallas
-    # double-buffers in/out blocks, so the budget is 2x(x_proj block +
-    # out block); [90, 64, 384] bf16 = 4.4 MB keeps the total ~12 MB.
-    # Blocks are independent recurrences, so the sequential TPU grid
-    # just re-runs the T-loop per block. Odd batch sizes are padded up to
-    # the block multiple (zero rows recur independently; sliced off).
-    b_blk = B if B <= 64 else 64
-    pad = (-B) % b_blk
-    if pad:
-        x_proj = jnp.concatenate(
-            [x_proj, jnp.zeros((T, pad, x_proj.shape[2]), x_proj.dtype)], axis=1
-        )
-
-    Bp = B + pad
-    out = pl.pallas_call(
-        _gru_kernel(T, hidden, reverse, x_proj.dtype),
-        grid=(Bp // b_blk,),
-        out_shape=jax.ShapeDtypeStruct((T, Bp, hidden), x_proj.dtype),
-        in_specs=[
-            pl.BlockSpec((T, b_blk, 3 * hidden), lambda i: (0, i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((hidden, 3 * hidden), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3 * hidden), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((T, b_blk, hidden), lambda i: (0, i, 0),
-                               memory_space=pltpu.VMEM),
-        scratch_shapes=[pltpu.VMEM((b_blk, hidden), jnp.float32)],
-        interpret=interpret,
-    )(x_proj, params["w_hh"], params["b_hh"].reshape(1, -1))
-
-    if pad:
-        out = out[:, :B]
-    # stay in compute_dtype between layers so the next layer's hoisted
-    # input projection keeps bf16 MXU throughput; the stack casts the
-    # final output to f32
-    return out.swapaxes(0, 1)  # [B,T,H] compute_dtype
+def fused_bidir_layer(
+    layer: Dict[str, Dict[str, jax.Array]],
+    x: jax.Array,
+    *,
+    interpret: bool = False,
+    compute_dtype=jnp.float32,
+) -> jax.Array:
+    """One bidirectional layer in a single kernel launch:
+    [B,T,in] -> [B,T,2H] (fwd ++ bwd on the feature axis)."""
+    static = ((False, True), bool(interpret), jnp.dtype(compute_dtype).name)
+    ys = _gru_multi(static, *_dir_arrays([layer["fwd"], layer["bwd"]]), x)
+    return jnp.concatenate([ys[0], ys[1]], axis=-1)
 
 
 def bidir_gru_stack_pallas(
     params,
     x: jax.Array,
     *,
+    dropout: float = 0.0,
+    deterministic: bool = True,
+    rng: jax.Array | None = None,
     interpret: bool = False,
     compute_dtype=jnp.float32,
 ) -> jax.Array:
-    """Stacked bidirectional GRU on the fused kernel, [B,T,in] ->
-    [B,T,2H]. Inference only (no dropout, no VJP)."""
-    for layer in params:
-        fwd = gru_direction_pallas(
-            layer["fwd"], x, False, interpret=interpret, compute_dtype=compute_dtype
+    """Stacked bidirectional GRU on the fused kernels, [B,T,in] ->
+    [B,T,2H]. Train-capable: the custom VJP backs propagation through
+    every layer; dropout (between layers only, torch.nn.GRU placement)
+    is applied outside the kernels."""
+    num_layers = len(params)
+    for i, layer in enumerate(params):
+        x = fused_bidir_layer(
+            layer, x, interpret=interpret, compute_dtype=compute_dtype
         )
-        bwd = gru_direction_pallas(
-            layer["bwd"], x, True, interpret=interpret, compute_dtype=compute_dtype
-        )
-        x = jnp.concatenate([fwd, bwd], axis=-1)
+        if dropout > 0.0 and not deterministic and i < num_layers - 1:
+            assert rng is not None
+            rng, sub = jax.random.split(rng)
+            x = _dropout(sub, x, dropout)
     return x.astype(jnp.float32)

@@ -1,5 +1,5 @@
-"""Pallas fused GRU kernel vs the lax.scan reference path (interpret
-mode on the CPU test mesh)."""
+"""Pallas fused GRU kernels vs the lax.scan reference path (interpret
+mode on the CPU test mesh) — forward AND backward (custom VJP)."""
 
 import numpy as np
 import pytest
@@ -8,9 +8,13 @@ import jax
 import jax.numpy as jnp
 
 from roko_tpu.config import ModelConfig
-from roko_tpu.models.gru import RokoGRU, gru_direction
+from roko_tpu.models.gru import RokoGRU, bidir_gru_stack, gru_direction
 from roko_tpu.models.model import RokoModel
-from roko_tpu.models.pallas_gru import bidir_gru_stack_pallas, gru_direction_pallas
+from roko_tpu.models.pallas_gru import (
+    bidir_gru_stack_pallas,
+    fused_bidir_layer,
+    gru_direction_pallas,
+)
 
 
 @pytest.mark.parametrize("reverse", [False, True])
@@ -21,6 +25,23 @@ def test_pallas_direction_matches_scan(rng, reverse):
 
     want = gru_direction(params, x, reverse=reverse)
     got = gru_direction_pallas(params, x, reverse, interpret=True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
+
+
+def test_fused_bidir_layer_matches_scan(rng):
+    """Both directions in one launch == fwd ++ bwd of the scan path."""
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
+    layer = gru.init(jax.random.PRNGKey(7))[0]
+    x = jnp.asarray(rng.standard_normal((5, 90, 24)), jnp.float32)
+
+    want = jnp.concatenate(
+        [
+            gru_direction(layer["fwd"], x, reverse=False),
+            gru_direction(layer["bwd"], x, reverse=True),
+        ],
+        axis=-1,
+    )
+    got = fused_bidir_layer(layer, x, interpret=True)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
 
 
@@ -49,18 +70,64 @@ def test_model_use_pallas_forward(rng):
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
 
 
-def test_pallas_training_path_falls_back(rng):
-    """Training (deterministic=False) must keep the differentiable scan
-    path even when use_pallas is set."""
-    cfg = ModelConfig(
-        embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1, use_pallas=True
-    )
-    model = RokoModel(cfg)
-    params = model.init(jax.random.PRNGKey(3))
-    x = rng.integers(0, 12, (2, 200, 90)).astype(np.uint8)
+@pytest.mark.parametrize("reverse", [False, True])
+def test_pallas_direction_grads_match_scan(rng, reverse):
+    """Custom-VJP backward kernel == autodiff through the scan path, for
+    every parameter and the input."""
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
+    params = gru.init(jax.random.PRNGKey(3))[0]["fwd"]
+    x = jnp.asarray(rng.standard_normal((4, 90, 24)), jnp.float32)
+    # non-uniform cotangent so every (t, b, h) grad path is exercised
+    ct = jnp.asarray(rng.standard_normal((4, 90, 16)), jnp.float32)
+
+    def loss_scan(p, x):
+        return jnp.sum(gru_direction(p, x, reverse=reverse) * ct)
+
+    def loss_pallas(p, x):
+        return jnp.sum(gru_direction_pallas(p, x, reverse, interpret=True) * ct)
+
+    want = jax.grad(loss_scan, argnums=(0, 1))(params, x)
+    got = jax.grad(loss_pallas, argnums=(0, 1))(params, x)
+    for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_stack_grads_match_scan(rng):
+    """Full 3-layer bidirectional stack: grads through the fused kernels
+    match autodiff through the scan stack."""
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=3, dropout=0.0)
+    params = gru.init(jax.random.PRNGKey(4))
+    x = jnp.asarray(rng.standard_normal((3, 90, 24)), jnp.float32)
+    ct = jnp.asarray(rng.standard_normal((3, 90, 32)), jnp.float32)
+
+    def loss_scan(p):
+        return jnp.sum(bidir_gru_stack(p, x) * ct)
+
+    def loss_pallas(p):
+        return jnp.sum(bidir_gru_stack_pallas(p, x, interpret=True) * ct)
+
+    want = jax.grad(loss_scan)(params)
+    got = jax.grad(loss_pallas)(params)
+    for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_training_dropout_path(rng):
+    """use_pallas training forward (deterministic=False) is
+    differentiable with dropout between layers."""
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=2, dropout=0.2)
+    params = gru.init(jax.random.PRNGKey(5))
+    x = jnp.asarray(rng.standard_normal((2, 90, 24)), jnp.float32)
 
     def loss(p):
-        out = model.apply(p, x, deterministic=False, rng=jax.random.PRNGKey(4))
+        out = bidir_gru_stack_pallas(
+            p,
+            x,
+            dropout=0.2,
+            deterministic=False,
+            rng=jax.random.PRNGKey(6),
+            interpret=True,
+        )
         return jnp.sum(out**2)
 
     grads = jax.grad(loss)(params)
@@ -69,12 +136,82 @@ def test_pallas_training_path_falls_back(rng):
 
 
 def test_pallas_odd_batch_pads(rng):
-    """Batch sizes that don't divide the 64-row block are padded and
-    sliced, not rejected."""
+    """Batch sizes that aren't a multiple of the 16-row alignment are
+    padded and sliced, not rejected (97 -> one 112-row block, 15 pad
+    rows that must recur independently and slice off)."""
     gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
     params = gru.init(jax.random.PRNGKey(5))[0]["fwd"]
-    x = jnp.asarray(rng.standard_normal((96, 90, 24)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((97, 90, 24)), jnp.float32)
     want = gru_direction(params, x, reverse=False)
     got = gru_direction_pallas(params, x, False, interpret=True)
     assert got.shape == want.shape
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_multi_time_block_path(rng, monkeypatch):
+    """Force nt>1 (time-blocked streaming with hs_bound boundary rows
+    and scratch carry across blocks) — the path real TPU shapes take but
+    small test shapes wouldn't: with a tiny VMEM budget T=90 splits into
+    multiple blocks in both the forward and backward kernels."""
+    import roko_tpu.models.pallas_gru as pg
+
+    monkeypatch.setattr(pg, "_VMEM_BUDGET", 64 * 1024)
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
+    layer = gru.init(jax.random.PRNGKey(9))[0]
+    x = jnp.asarray(rng.standard_normal((5, 90, 24)), jnp.float32)
+    ct = jnp.asarray(rng.standard_normal((5, 90, 32)), jnp.float32)
+    # the tiny budget must actually split time (else the test is void)
+    assert pg._pick_blocks(90, 5, 16, 4, bwd=False)[0] < 90
+    assert pg._pick_blocks(90, 5, 16, 4, bwd=True)[0] < 90
+
+    def loss_scan(p, x):
+        return jnp.sum(
+            jnp.concatenate(
+                [
+                    gru_direction(p["fwd"], x, reverse=False),
+                    gru_direction(p["bwd"], x, reverse=True),
+                ],
+                axis=-1,
+            )
+            * ct
+        )
+
+    def loss_pallas(p, x):
+        return jnp.sum(pg.fused_bidir_layer(p, x, interpret=True) * ct)
+
+    want_y = jnp.concatenate(
+        [
+            gru_direction(layer["fwd"], x, reverse=False),
+            gru_direction(layer["bwd"], x, reverse=True),
+        ],
+        axis=-1,
+    )
+    got_y = pg.fused_bidir_layer(layer, x, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(want_y), np.asarray(got_y), rtol=1e-5, atol=1e-5
+    )
+    want = jax.grad(loss_scan, argnums=(0, 1))(layer, x)
+    got = jax.grad(loss_pallas, argnums=(0, 1))(layer, x)
+    for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(w), np.asarray(g), rtol=1e-4, atol=1e-4)
+
+
+def test_pallas_bf16_mode_close(rng):
+    """bfloat16 compute mode stays within bf16 tolerance of the f32
+    scan path (states round-trip through bf16 between steps)."""
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
+    layer = gru.init(jax.random.PRNGKey(8))[0]
+    x = jnp.asarray(rng.standard_normal((4, 90, 24)), jnp.float32)
+    want = jnp.concatenate(
+        [
+            gru_direction(layer["fwd"], x, reverse=False),
+            gru_direction(layer["bwd"], x, reverse=True),
+        ],
+        axis=-1,
+    )
+    got = fused_bidir_layer(
+        layer, x, interpret=True, compute_dtype=jnp.bfloat16
+    )
+    np.testing.assert_allclose(
+        np.asarray(want), np.asarray(got, dtype=np.float32), rtol=0.1, atol=0.1
+    )
