@@ -55,6 +55,18 @@ def reg2bin(beg: int, end: int) -> int:
     return 0
 
 
+def reg2bins(beg: int, end: int) -> List[int]:
+    """All bins that may hold records overlapping [beg, end)
+    (SAM spec §5.3 list-of-bins recurrence)."""
+    end -= 1
+    bins = [0]
+    for base, shift in (
+        (1, 26), (9, 23), (73, 20), (585, 17), (4681, 14)
+    ):
+        bins.extend(range(base + (beg >> shift), base + (end >> shift) + 1))
+    return bins
+
+
 @dataclass
 class BamRecord:
     name: str
@@ -274,7 +286,8 @@ class BamReader:
             n: i for i, (n, _) in enumerate(self.references)
         }
         self._first_record_voffset = self._bgzf.tell_virtual()
-        self._linear_index: Optional[List[List[int]]] = None
+        self._index = None
+        self._warned_no_index = False
 
     # -- raw iteration ------------------------------------------------------
     def _read_record(self) -> Optional[BamRecord]:
@@ -296,11 +309,25 @@ class BamReader:
             yield rec
 
     # -- indexed fetch ------------------------------------------------------
-    def _load_index(self) -> Optional[List[List[int]]]:
-        if self._linear_index is not None:
-            return self._linear_index
+    def _load_index(self):
+        """Parse the full ``.bai``: per ref, (bins: {bin -> [(chunk_beg,
+        chunk_end)]}, linear ioffsets). Returns None (with a one-time
+        warning) when no index exists — fetch then falls back to a full
+        scan from the first record, O(file) per region."""
+        if self._index is not None:
+            return self._index
         bai_path = self.path + ".bai"
         if not os.path.exists(bai_path):
+            if not self._warned_no_index:
+                self._warned_no_index = True
+                import warnings
+
+                warnings.warn(
+                    f"{self.path}: no .bai index — every fetch() scans "
+                    "from the first record (O(file size) per region). "
+                    "Write the BAM through BamWriter to get an index.",
+                    stacklevel=3,
+                )
             return None
         with open(bai_path, "rb") as fh:
             data = fh.read()
@@ -309,41 +336,91 @@ class BamReader:
         off = 4
         n_ref = struct.unpack_from("<i", data, off)[0]
         off += 4
-        index: List[List[int]] = []
+        index: List[Tuple[Dict[int, List[Tuple[int, int]]], List[int]]] = []
         for _ in range(n_ref):
             n_bin = struct.unpack_from("<i", data, off)[0]
             off += 4
+            bins: Dict[int, List[Tuple[int, int]]] = {}
             for _ in range(n_bin):
-                _bin, n_chunk = struct.unpack_from("<Ii", data, off)
-                off += 8 + 16 * n_chunk
+                bin_id, n_chunk = struct.unpack_from("<Ii", data, off)
+                off += 8
+                chunks = []
+                for _ in range(n_chunk):
+                    beg, cend = struct.unpack_from("<QQ", data, off)
+                    off += 16
+                    chunks.append((beg, cend))
+                bins[bin_id] = chunks
             n_intv = struct.unpack_from("<i", data, off)[0]
             off += 4
             ioffsets = list(struct.unpack_from(f"<{n_intv}Q", data, off))
             off += 8 * n_intv
-            index.append(ioffsets)
-        self._linear_index = index
+            index.append((bins, ioffsets))
+        self._index = index
         return index
+
+    def _linear_min_voffset(self, ioffsets: List[int], start: int) -> int:
+        """Smallest useful virtual offset from the linear index: records
+        overlapping ``start`` cannot begin before it."""
+        if not ioffsets:
+            return 0
+        i = min(start >> _LINEAR_SHIFT, len(ioffsets) - 1)
+        while i >= 0 and ioffsets[i] == 0:
+            i -= 1
+        return ioffsets[i] if i >= 0 else 0
+
+    def _region_chunks(
+        self, tid: int, start: int, end: int
+    ) -> Optional[List[Tuple[int, int]]]:
+        """htslib-style region query: candidate bins' chunks, pruned by
+        the linear index, merged when overlapping/adjacent. None when no
+        index (or an old linear-only index) is available."""
+        index = self._load_index()
+        if index is None or tid >= len(index):
+            return None
+        bins, ioffsets = index[tid]
+        if not bins:
+            return None  # linear-only .bai (our own pre-bin writer)
+        min_voff = self._linear_min_voffset(ioffsets, start)
+        chunks = []
+        for b in reg2bins(start, end):
+            for beg, cend in bins.get(b, ()):
+                if cend > min_voff:
+                    chunks.append((max(beg, min_voff), cend))
+        chunks.sort()
+        merged: List[Tuple[int, int]] = []
+        for beg, cend in chunks:
+            if merged and beg <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], cend))
+            else:
+                merged.append((beg, cend))
+        return merged
 
     def fetch(
         self, contig: str, start: int = 0, end: Optional[int] = None
     ) -> Iterator[BamRecord]:
         """Yield mapped records overlapping ``[start, end)`` on ``contig``
-        in file (coordinate) order."""
+        in file (coordinate) order. With a binned ``.bai`` the read is
+        restricted to the region's chunk list (htslib semantics, ref:
+        Dependencies/htslib-1.9/htslib/sam.h bin+chunk query); a
+        linear-only index gives a tight start offset; no index falls
+        back to a full scan (with a warning)."""
         if contig not in self.tid_by_name:
             raise KeyError(f"unknown contig {contig!r}")
         tid = self.tid_by_name[contig]
         if end is None:
             end = self.references[tid][1]
 
+        chunks = self._region_chunks(tid, start, end)
+        if chunks is not None:
+            yield from self._fetch_chunks(chunks, tid, start, end)
+            return
+
         voffset = self._first_record_voffset
         index = self._load_index()
-        if index is not None and tid < len(index) and index[tid]:
-            ioffsets = index[tid]
-            i = min(start >> _LINEAR_SHIFT, len(ioffsets) - 1)
-            while i >= 0 and ioffsets[i] == 0:
-                i -= 1
-            if i >= 0:
-                voffset = ioffsets[i]
+        if index is not None and tid < len(index):
+            lin = self._linear_min_voffset(index[tid][1], start)
+            if lin:
+                voffset = lin
         self._bgzf.seek_virtual(voffset)
 
         while True:
@@ -362,6 +439,26 @@ class BamReader:
             if rec.reference_end > start:
                 yield rec
 
+    def _fetch_chunks(
+        self, chunks: List[Tuple[int, int]], tid: int, start: int, end: int
+    ) -> Iterator[BamRecord]:
+        for beg, cend in chunks:
+            self._bgzf.seek_virtual(beg)
+            while self._bgzf.tell_virtual() < cend:
+                rec = self._read_record()
+                if rec is None:
+                    return
+                if rec.tid != tid:
+                    if rec.tid > tid or rec.tid < 0:
+                        return  # coordinate-sorted: past our contig
+                    continue
+                if rec.pos >= end:
+                    return  # coordinate-sorted: past the region
+                if rec.is_unmapped:
+                    continue
+                if rec.reference_end > start:
+                    yield rec
+
     def close(self) -> None:
         self._bgzf.close()
 
@@ -373,9 +470,10 @@ class BamReader:
 
 
 class BamWriter:
-    """Writes a coordinate-sorted BAM and its ``.bai`` (linear index only —
-    bins are omitted; :class:`BamReader` and the native extractor use the
-    linear index exclusively)."""
+    """Writes a coordinate-sorted BAM and its ``.bai`` with the full
+    bin+chunk structure plus the linear index (SAM spec §5.1.3/§5.3 —
+    the same layout htslib emits), so :class:`BamReader` and the native
+    extractor can restrict region fetches to the relevant chunks."""
 
     def __init__(self, path: str, references: Sequence[Tuple[str, int]]):
         self.path = path
@@ -391,8 +489,12 @@ class BamWriter:
         for name, length in self.references:
             nb = name.encode() + b"\x00"
             self._bgzf.write(struct.pack("<i", len(nb)) + nb + struct.pack("<i", length))
-        # linear index accumulator: per ref, interval -> min voffset
+        # index accumulators: per ref, interval -> min voffset (linear)
+        # and bin -> [(chunk_beg, chunk_end)] (distributed bins)
         self._ioffsets: List[Dict[int, int]] = [dict() for _ in self.references]
+        self._bins: List[Dict[int, List[List[int]]]] = [
+            dict() for _ in self.references
+        ]
         self._last_key: Optional[Tuple[int, int]] = None
 
     def write(self, rec: BamRecord) -> None:
@@ -404,8 +506,19 @@ class BamWriter:
         voffset = self._bgzf.tell_virtual()
         self._bgzf.write(_encode_record(rec))
         if rec.tid >= 0 and not rec.is_unmapped:
-            for iv in range(rec.pos >> _LINEAR_SHIFT, ((max(rec.reference_end, rec.pos + 1) - 1) >> _LINEAR_SHIFT) + 1):
+            rec_end = max(rec.reference_end, rec.pos + 1)
+            for iv in range(rec.pos >> _LINEAR_SHIFT, (rec_end - 1 >> _LINEAR_SHIFT) + 1):
                 self._ioffsets[rec.tid].setdefault(iv, voffset)
+            # extend the bin's open chunk when records are contiguous in
+            # the file (htslib merges exactly this way), else open one
+            vend = self._bgzf.tell_virtual()
+            chunks = self._bins[rec.tid].setdefault(
+                reg2bin(rec.pos, rec_end), []
+            )
+            if chunks and chunks[-1][1] == voffset:
+                chunks[-1][1] = vend
+            else:
+                chunks.append([voffset, vend])
 
     def close(self) -> None:
         self._bgzf.close()
@@ -413,7 +526,13 @@ class BamWriter:
             fh.write(_BAI_MAGIC)
             fh.write(struct.pack("<i", len(self.references)))
             for tid in range(len(self.references)):
-                fh.write(struct.pack("<i", 0))  # n_bin
+                bins = self._bins[tid]
+                fh.write(struct.pack("<i", len(bins)))
+                for bin_id in sorted(bins):
+                    chunks = bins[bin_id]
+                    fh.write(struct.pack("<Ii", bin_id, len(chunks)))
+                    for beg, cend in chunks:
+                        fh.write(struct.pack("<QQ", beg, cend))
                 ivs = self._ioffsets[tid]
                 n_intv = (max(ivs) + 1) if ivs else 0
                 fh.write(struct.pack("<i", n_intv))

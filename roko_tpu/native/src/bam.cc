@@ -1,5 +1,6 @@
 #include "bam.h"
 
+#include <algorithm>
 #include <cstring>
 
 namespace roko {
@@ -169,8 +170,8 @@ bool BamReader::ReadRecord(BamRecord* rec) {
   return true;
 }
 
-const std::vector<std::vector<uint64_t>>* BamReader::LoadLinearIndex() {
-  if (index_loaded_) return index_present_ ? &linear_index_ : nullptr;
+const std::vector<BamReader::RefIndex>* BamReader::LoadIndex() {
+  if (index_loaded_) return index_present_ ? &index_ : nullptr;
   index_loaded_ = true;
   std::string bai_path = path_ + ".bai";
   std::FILE* fh = std::fopen(bai_path.c_str(), "rb");
@@ -195,7 +196,7 @@ const std::vector<std::vector<uint64_t>>* BamReader::LoadLinearIndex() {
   int32_t n_ref = ReadI32(data.data() + off);
   off += 4;
   if (n_ref < 0) throw BgzfError(bai_path + ": corrupt BAI index");
-  linear_index_.resize(n_ref);
+  index_.resize(n_ref);
   for (int32_t r = 0; r < n_ref; ++r) {
     need(4);
     int32_t n_bin = ReadI32(data.data() + off);
@@ -203,22 +204,85 @@ const std::vector<std::vector<uint64_t>>* BamReader::LoadLinearIndex() {
     if (n_bin < 0) throw BgzfError(bai_path + ": corrupt BAI index");
     for (int32_t b = 0; b < n_bin; ++b) {
       need(8);
+      uint32_t bin_id;
+      std::memcpy(&bin_id, data.data() + off, 4);
       int32_t n_chunk = ReadI32(data.data() + off + 4);
       if (n_chunk < 0) throw BgzfError(bai_path + ": corrupt BAI index");
       need(8 + 16ul * n_chunk);
-      off += 8 + 16ul * static_cast<size_t>(n_chunk);
+      off += 8;
+      auto& chunks = index_[r].bins[bin_id];
+      chunks.reserve(n_chunk);
+      for (int32_t c = 0; c < n_chunk; ++c) {
+        uint64_t beg, cend;
+        std::memcpy(&beg, data.data() + off, 8);
+        std::memcpy(&cend, data.data() + off + 8, 8);
+        off += 16;
+        chunks.emplace_back(beg, cend);
+      }
     }
     need(4);
     int32_t n_intv = ReadI32(data.data() + off);
     off += 4;
     if (n_intv < 0) throw BgzfError(bai_path + ": corrupt BAI index");
     need(8ul * n_intv);
-    linear_index_[r].resize(n_intv);
-    std::memcpy(linear_index_[r].data(), data.data() + off, 8ul * n_intv);
+    index_[r].ioffsets.resize(n_intv);
+    std::memcpy(index_[r].ioffsets.data(), data.data() + off, 8ul * n_intv);
     off += 8ul * n_intv;
   }
   index_present_ = true;
-  return &linear_index_;
+  return &index_;
+}
+
+namespace {
+// Candidate bins possibly holding records overlapping [beg, end)
+// (SAM spec §5.3 recurrence).
+void Reg2Bins(int64_t beg, int64_t end, std::vector<uint32_t>* bins) {
+  --end;
+  bins->push_back(0);
+  static constexpr struct { uint32_t base; int shift; } kLevels[] = {
+      {1, 26}, {9, 23}, {73, 20}, {585, 17}, {4681, 14}};
+  for (const auto& lv : kLevels)
+    for (int64_t k = lv.base + (beg >> lv.shift);
+         k <= lv.base + (end >> lv.shift); ++k)
+      bins->push_back(static_cast<uint32_t>(k));
+}
+
+uint64_t LinearMinVoffset(const std::vector<uint64_t>& ioffsets,
+                          int64_t start) {
+  if (ioffsets.empty()) return 0;
+  int64_t i = std::min<int64_t>(start >> kLinearShift,
+                                static_cast<int64_t>(ioffsets.size()) - 1);
+  while (i >= 0 && ioffsets[i] == 0) --i;
+  return i >= 0 ? ioffsets[i] : 0;
+}
+}  // namespace
+
+bool BamReader::RegionChunks(int tid, int64_t start, int64_t end,
+                             std::vector<std::pair<uint64_t, uint64_t>>* out) {
+  const auto* index = LoadIndex();
+  if (!index || tid >= static_cast<int>(index->size())) return false;
+  const RefIndex& ref = (*index)[tid];
+  if (ref.bins.empty()) return false;  // linear-only .bai
+  uint64_t min_voff = LinearMinVoffset(ref.ioffsets, start);
+  std::vector<uint32_t> bins;
+  Reg2Bins(start, end, &bins);
+  std::vector<std::pair<uint64_t, uint64_t>> chunks;
+  for (uint32_t b : bins) {
+    auto it = ref.bins.find(b);
+    if (it == ref.bins.end()) continue;
+    for (const auto& ch : it->second)
+      if (ch.second > min_voff)
+        chunks.emplace_back(std::max(ch.first, min_voff), ch.second);
+  }
+  std::sort(chunks.begin(), chunks.end());
+  out->clear();
+  for (const auto& ch : chunks) {
+    if (!out->empty() && ch.first <= out->back().second)
+      out->back().second = std::max(out->back().second, ch.second);
+    else
+      out->push_back(ch);
+  }
+  return true;
 }
 
 std::vector<BamRecord> BamReader::Fetch(const std::string& contig,
@@ -227,19 +291,35 @@ std::vector<BamRecord> BamReader::Fetch(const std::string& contig,
   if (tid < 0) throw BgzfError(path_ + ": unknown contig " + contig);
   if (end < 0) end = references_[tid].second;
 
+  std::vector<BamRecord> out;
+  BamRecord rec;
+
+  std::vector<std::pair<uint64_t, uint64_t>> chunks;
+  if (RegionChunks(tid, start, end, &chunks)) {
+    // binned query: read only the region's chunk list (htslib shape)
+    for (const auto& ch : chunks) {
+      bgzf_->SeekVirtual(ch.first);
+      while (bgzf_->TellVirtual() < ch.second && ReadRecord(&rec)) {
+        if (rec.tid != tid) {
+          if (rec.tid > tid || rec.tid < 0) return out;  // sorted: past
+          continue;
+        }
+        if (rec.pos >= end) return out;
+        if (rec.IsUnmapped()) continue;
+        if (rec.ReferenceEnd() > start) out.push_back(rec);
+      }
+    }
+    return out;
+  }
+
   uint64_t voffset = first_record_voffset_;
-  const auto* index = LoadLinearIndex();
-  if (index && tid < static_cast<int>(index->size()) && !(*index)[tid].empty()) {
-    const auto& ioffsets = (*index)[tid];
-    int64_t i = std::min<int64_t>(start >> kLinearShift,
-                                  static_cast<int64_t>(ioffsets.size()) - 1);
-    while (i >= 0 && ioffsets[i] == 0) --i;
-    if (i >= 0) voffset = ioffsets[i];
+  const auto* index = LoadIndex();
+  if (index && tid < static_cast<int>(index->size())) {
+    uint64_t lin = LinearMinVoffset((*index)[tid].ioffsets, start);
+    if (lin) voffset = lin;
   }
   bgzf_->SeekVirtual(voffset);
 
-  std::vector<BamRecord> out;
-  BamRecord rec;
   while (ReadRecord(&rec)) {
     if (rec.tid != tid) {
       if (rec.tid > tid || rec.tid < 0) break;  // coordinate-sorted

@@ -1,11 +1,12 @@
-// BAM container parsing + BAI linear-index region fetch.
+// BAM container parsing + full BAI (bin + linear) region fetch.
 //
 // Native replacement for the reference's htslib usage (readBAM /
 // sam_itr_querys / bam_itr pattern, ref: models.cpp:37-101): parses the
 // BAM binary layout (SAM spec §4.2) directly over roko::BgzfReader and
-// serves coordinate-order region queries via the .bai linear index
-// (bins are ignored; the linear index alone bounds the scan start,
-// mirroring roko_tpu/io/bam.py::BamReader.fetch).
+// serves coordinate-order region queries via the .bai distributed bins
+// pruned by the linear index — the htslib query shape — mirroring
+// roko_tpu/io/bam.py::BamReader.fetch (linear-only indexes still work;
+// no index falls back to a full scan).
 #pragma once
 
 #include <cstdint>
@@ -47,15 +48,25 @@ class BamReader {
                                int64_t end);
 
  private:
+  struct RefIndex {
+    std::unordered_map<uint32_t, std::vector<std::pair<uint64_t, uint64_t>>>
+        bins;  // bin id -> [(chunk_beg, chunk_end)] virtual offsets
+    std::vector<uint64_t> ioffsets;  // 16 kb linear index
+  };
+
   bool ReadRecord(BamRecord* rec);  // false at EOF
-  const std::vector<std::vector<uint64_t>>* LoadLinearIndex();
+  const std::vector<RefIndex>* LoadIndex();
+  // Merged chunk list for [start, end) on tid; false when the index (or
+  // its bin section) is unavailable and the caller must linear-scan.
+  bool RegionChunks(int tid, int64_t start, int64_t end,
+                    std::vector<std::pair<uint64_t, uint64_t>>* out);
 
   std::string path_;
   std::unique_ptr<BgzfReader> bgzf_;
   std::vector<std::pair<std::string, int64_t>> references_;
   std::unordered_map<std::string, int> tid_by_name_;
   uint64_t first_record_voffset_ = 0;
-  std::vector<std::vector<uint64_t>> linear_index_;
+  std::vector<RefIndex> index_;
   bool index_loaded_ = false;
   bool index_present_ = false;
 };

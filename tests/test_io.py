@@ -180,3 +180,94 @@ def test_writer_rejects_unsorted(tmp_path):
     w.write(make_record("r1", 0, 100, "ACGT", cigar_from_string("4M")))
     with pytest.raises(ValueError):
         w.write(make_record("r2", 0, 50, "ACGT", cigar_from_string("4M")))
+
+
+def test_bai_bins_emitted_and_used(tmp_path, py_random):
+    """The writer emits the full bin+chunk index and the reader's fetch
+    walks the region's chunk list (VERDICT r2 task #10)."""
+    import struct
+
+    from roko_tpu.io.bam import _BAI_MAGIC
+
+    ref = random_seq(py_random, 200_000)
+    refs = [("ctg", len(ref))]
+    records = simulate_reads(py_random, ref, 0, coverage=4, read_len=400)
+    path = str(tmp_path / "b.bam")
+    write_sorted_bam(path, refs, records)
+
+    with open(path + ".bai", "rb") as fh:
+        data = fh.read()
+    assert data[:4] == _BAI_MAGIC
+    n_bin = struct.unpack_from("<i", data, 8)[0]
+    assert n_bin > 1  # real distributed bins, not the legacy 0
+
+    with BamReader(path) as r:
+        chunks = r._region_chunks(0, 150_000, 151_000)
+        assert chunks  # binned query path active
+        got = {rec.name for rec in r.fetch("ctg", 150_000, 151_000)}
+    expected = {
+        rec.name
+        for rec in records
+        if rec.pos < 151_000 and rec.reference_end > 150_000
+    }
+    assert got == expected
+
+
+def test_fetch_legacy_linear_only_index(tmp_path, py_random):
+    """A linear-only .bai (n_bin == 0, our pre-bin writer layout) still
+    fetches correctly via the linear-start fallback."""
+    import struct
+
+    from roko_tpu.io.bam import _BAI_MAGIC
+
+    ref = random_seq(py_random, 50_000)
+    refs = [("ctg", len(ref))]
+    records = simulate_reads(py_random, ref, 0, coverage=4, read_len=400)
+    path = str(tmp_path / "lin.bam")
+    write_sorted_bam(path, refs, records)
+
+    # rewrite the index with bins stripped
+    with BamReader(path) as r:
+        _, ioffsets = r._load_index()[0]
+    with open(path + ".bai", "wb") as fh:
+        fh.write(_BAI_MAGIC)
+        fh.write(struct.pack("<i", 1))
+        fh.write(struct.pack("<i", 0))  # n_bin = 0
+        fh.write(struct.pack("<i", len(ioffsets)))
+        for v in ioffsets:
+            fh.write(struct.pack("<Q", v))
+
+    with BamReader(path) as r:
+        assert r._region_chunks(0, 20_000, 21_000) is None
+        got = {rec.name for rec in r.fetch("ctg", 20_000, 21_000)}
+    expected = {
+        rec.name
+        for rec in records
+        if rec.pos < 21_000 and rec.reference_end > 20_000
+    }
+    assert got == expected
+
+
+def test_fetch_without_index_warns_and_scans(tmp_path, py_random):
+    """No .bai: fetch falls back to a full scan and warns once."""
+    import os
+    import warnings
+
+    ref = random_seq(py_random, 20_000)
+    refs = [("ctg", len(ref))]
+    records = simulate_reads(py_random, ref, 0, coverage=3, read_len=300)
+    path = str(tmp_path / "noidx.bam")
+    write_sorted_bam(path, refs, records)
+    os.remove(path + ".bai")
+
+    with BamReader(path) as r, warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        got = {rec.name for rec in r.fetch("ctg", 5_000, 6_000)}
+        _ = list(r.fetch("ctg", 7_000, 8_000))
+    assert sum("no .bai index" in str(x.message) for x in w) == 1
+    expected = {
+        rec.name
+        for rec in records
+        if rec.pos < 6_000 and rec.reference_end > 5_000
+    }
+    assert got == expected
