@@ -68,22 +68,48 @@ def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
 
 
 class VoteBoard:
-    """Per-contig vote accumulator: uint16[contig_len * 4 slots, 5]."""
+    """Per-contig vote accumulator.
 
-    def __init__(self, contigs: Dict[str, str]):
+    Two representations, switched on draft length (VERDICT r2 task #7):
+
+    - **dense** (below ``sparse_threshold`` bases): one
+      ``uint16[contig_len * 4 slots, 5]`` array — 40 B/draft-base, the
+      fast path for the reference's bacterial-scale targets;
+    - **sparse-insertions** (at/above the threshold): a dense
+      ``uint16[contig_len, 5]`` array for the ins=0 slots every window
+      votes on (uint16 keeps the dense path's overflow headroom —
+      ``np.add.at`` wraps silently, and a stride-1/--region-overlap
+      config can push counts into the hundreds) plus a hash map for the
+      rare ins>0 slots. Memory budget: ~10 B/draft-base + ~64 B per
+      *touched* insertion slot, so a 50 Mb draft polishes in ~0.5 GB of
+      board instead of 2 GB, and a 3.2 Gb human-scale draft in ~32 GB
+      instead of 128 GB.
+
+    Both representations produce identical stitches (tested with a
+    forced threshold).
+    """
+
+    def __init__(self, contigs: Dict[str, str], sparse_threshold: int = 2**25):
         self.contigs = contigs
+        self.sparse_threshold = sparse_threshold
         self._votes: Dict[str, np.ndarray] = {}
+        self._ins: Dict[str, Dict[int, np.ndarray]] = {}
+
+    def _is_sparse(self, contig: str) -> bool:
+        return len(self.contigs[contig]) >= self.sparse_threshold
 
     def _board(self, contig: str) -> np.ndarray:
         b = self._votes.get(contig)
         if b is None:
             n = len(self.contigs[contig])
-            # uint16: a slot gets at most one vote per covering window,
-            # and window overlap (x3) times region overlap re-extraction
-            # keeps counts in single digits — 40 B/draft-base, not 80
-            b = self._votes[contig] = np.zeros(
-                (n * _SLOTS, C.NUM_CLASSES), np.uint16
-            )
+            if self._is_sparse(contig):
+                b = np.zeros((n, C.NUM_CLASSES), np.uint16)
+                self._ins[contig] = {}
+            else:
+                # uint16: a slot gets at most one vote per covering
+                # window; counts stay in single digits
+                b = np.zeros((n * _SLOTS, C.NUM_CLASSES), np.uint16)
+            self._votes[contig] = b
         return b
 
     def add(
@@ -92,16 +118,53 @@ class VoteBoard:
         """positions int64[B,90,2] (pos, ins); preds int[B,90]."""
         for i, name in enumerate(contigs):
             board = self._board(name)
-            flat = positions[i, :, 0] * _SLOTS + positions[i, :, 1]
-            np.add.at(board, (flat, preds[i]), 1)
+            if self._is_sparse(name):
+                ins_mask = positions[i, :, 1] != 0
+                base = ~ins_mask
+                np.add.at(
+                    board, (positions[i, base, 0], preds[i][base]), 1
+                )
+                ins_map = self._ins[name]
+                flat = (
+                    positions[i, ins_mask, 0] * _SLOTS
+                    + positions[i, ins_mask, 1]
+                )
+                for slot, p in zip(flat.tolist(), preds[i][ins_mask].tolist()):
+                    counts = ins_map.get(slot)
+                    if counts is None:
+                        counts = ins_map[slot] = np.zeros(
+                            C.NUM_CLASSES, np.uint16
+                        )
+                    counts[p] += 1
+            else:
+                flat = positions[i, :, 0] * _SLOTS + positions[i, :, 1]
+                np.add.at(board, (flat, preds[i]), 1)
+
+    def _covered_and_counts(self, contig: str):
+        """(covered flat slot ids sorted by (pos, ins), vote counts
+        [n,5]) in either representation."""
+        board = self._votes[contig]
+        if not self._is_sparse(contig):
+            covered = np.flatnonzero(board.sum(axis=1))
+            return covered, board[covered]
+        base_pos = np.flatnonzero(board.sum(axis=1))
+        base_slots = base_pos * _SLOTS
+        ins_map = self._ins[contig]
+        if ins_map:
+            ins_slots = np.fromiter(ins_map.keys(), np.int64, len(ins_map))
+            ins_counts = np.stack([ins_map[s] for s in ins_slots.tolist()])
+            covered = np.concatenate([base_slots, ins_slots])
+            counts = np.concatenate([board[base_pos], ins_counts])
+            order = np.argsort(covered, kind="stable")
+            return covered[order], counts[order]
+        return base_slots, board[base_pos]
 
     def stitch(self, contig: str) -> str:
         """Consensus for one contig (ref: roko/inference.py:129-151)."""
         draft = self.contigs[contig]
-        board = self._votes.get(contig)
-        if board is None:  # no windows at all -> draft unchanged
+        if contig not in self._votes:  # no windows at all -> draft unchanged
             return draft
-        covered = np.flatnonzero(board.sum(axis=1))  # sorted (pos,ins) order
+        covered, counts = self._covered_and_counts(contig)
         if covered.size == 0:
             return draft
         # drop leading insertion slots (ref :134; the reference would
@@ -112,11 +175,12 @@ class VoteBoard:
             return draft
         start = int(np.argmax(is_base_slot))  # first (pos, ins=0) entry
         covered = covered[start:]
+        counts = counts[start:]
         pos_of = (covered // _SLOTS)
 
         first_pos = int(pos_of[0])
         last_pos = int(pos_of[-1])
-        bases = np.argmax(board[covered], axis=1)  # ties -> lowest class
+        bases = np.argmax(counts, axis=1)  # ties -> lowest class
         keep = bases != C.ENCODED_GAP
         body = np.frombuffer(C.ALPHABET[: C.NUM_CLASSES].encode(), np.uint8)[
             bases[keep]
@@ -137,8 +201,30 @@ def run_inference(
 ) -> Dict[str, str]:
     """Predict votes for every window in ``data_path`` and stitch each
     contig; returns {contig: polished_seq}. ``trace_dir`` writes a
-    TensorBoard-loadable device trace of the batch loop."""
+    TensorBoard-loadable device trace of the batch loop.
+
+    Multi-host pods shard the work at **contig granularity**: process p
+    polishes contigs [p::process_count] on a mesh over its *local*
+    devices and returns only those (votes are host-side accumulators, so
+    contig ownership keeps them process-local — no cross-host vote
+    reduction needed; ``polish_to_fasta`` reassembles the FASTA)."""
+    from roko_tpu.parallel import distributed
+
+    distributed.initialize()  # no-op single host (SURVEY §5.8)
     cfg = cfg or RokoConfig()
+    nproc = jax.process_count()
+    contig_filter = None
+    contigs = load_contigs(data_path)
+    if nproc > 1 and mesh is None:
+        # per-process mesh over local devices only: dp absorbs them (the
+        # configured dp counted the whole pod); tp/sp keep their sizes
+        import dataclasses
+
+        mesh = make_mesh(
+            dataclasses.replace(cfg.mesh, dp=-1), devices=jax.local_devices()
+        )
+        contig_filter = set(sorted(contigs)[jax.process_index() :: nproc])
+        contigs = {k: v for k, v in contigs.items() if k in contig_filter}
     mesh = mesh or make_mesh(cfg.mesh)
     dp = mesh.shape[AXIS_DP]
     if batch_size % dp:
@@ -149,7 +235,6 @@ def run_inference(
     predict = make_predict_step(model, mesh)
     sharding = data_sharding(mesh)
 
-    contigs = load_contigs(data_path)
     board = VoteBoard(contigs)
     timer = StageTimer()
 
@@ -168,7 +253,11 @@ def run_inference(
     n_windows = 0
     with device_trace(trace_dir):
         for names, positions, x, n in prefetch_to_device(
-            iter_inference_windows(data_path, batch_size), prefetch, place
+            iter_inference_windows(
+                data_path, batch_size, contig_filter=contig_filter
+            ),
+            prefetch,
+            place,
         ):
             with timer("predict+d2h"):
                 preds = np.asarray(jax.device_get(predict(params, x)))[:n]
@@ -195,5 +284,31 @@ def polish_to_fasta(
     cfg: Optional[RokoConfig] = None,
     **kw: Any,
 ) -> None:
+    """Polish and write FASTA. On a pod every process writes its owned
+    contigs to ``out_path.part{p}`` (shared filesystem assumed, as for
+    checkpoints), synchronises, and the primary merges the parts in
+    draft order."""
     polished = run_inference(data_path, params, cfg, **kw)
-    write_fasta(out_path, list(polished.items()))
+    if jax.process_count() == 1:
+        write_fasta(out_path, list(polished.items()))
+        return
+
+    from jax.experimental import multihost_utils
+
+    part = f"{out_path}.part{jax.process_index()}"
+    write_fasta(part, list(polished.items()))
+    multihost_utils.sync_global_devices("roko_polish_parts_written")
+    if jax.process_index() == 0:
+        import os
+
+        from roko_tpu.io.fasta import read_fasta
+
+        merged: Dict[str, str] = {}
+        for p in range(jax.process_count()):
+            for name, seq in read_fasta(f"{out_path}.part{p}"):
+                merged[name] = seq
+        order = sorted(merged)  # contig_filter split sorted names
+        write_fasta(out_path, [(n, merged[n]) for n in order])
+        for p in range(jax.process_count()):
+            os.remove(f"{out_path}.part{p}")
+    multihost_utils.sync_global_devices("roko_polish_merged")

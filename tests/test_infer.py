@@ -131,3 +131,61 @@ def test_predict_step_batch_invariance(rng):
     padded = np.concatenate([x[:4], np.zeros((4, 200, 90), np.uint8)])
     half = np.asarray(jax.device_get(step(params, padded)))[:4]
     np.testing.assert_array_equal(full[:4], half)
+
+
+def test_sparse_board_matches_dense():
+    """The sparse-insertions representation (forced via threshold=0)
+    stitches identically to the dense board (VERDICT r2 task #7)."""
+    draft = "ACGTACGTACGTACGTACGT"
+    votes = [
+        (2, 0, T), (2, 0, T), (2, 0, G),
+        (3, 0, G), (3, 1, A), (3, 1, A), (3, 2, Cc),
+        (4, 0, GAP), (5, 0, A),
+        (10, 0, Cc), (10, 1, G),
+    ]
+    dense = VoteBoard({"c": draft}, sparse_threshold=10**9)
+    sparse = VoteBoard({"c": draft}, sparse_threshold=0)
+    _vote(dense, "c", votes)
+    _vote(sparse, "c", votes)
+    assert not dense._is_sparse("c") and sparse._is_sparse("c")
+    assert dense.stitch("c") == sparse.stitch("c")
+
+
+def test_sparse_board_memory_budget():
+    """Above the threshold the board allocates ~10 B/draft-base (plus a
+    constant per touched insertion slot), not 40 B/base: a simulated
+    50 Mb draft's board stays within its documented budget."""
+    n = 50_000_000
+    board = VoteBoard({"big": "A" * n}, sparse_threshold=2**25)
+    _vote(board, "big", [(0, 0, A), (n - 1, 0, Cc), (1000, 1, G)])
+    arr = board._votes["big"]
+    assert arr.dtype == np.uint16  # dense-path overflow headroom kept
+    assert arr.nbytes == 2 * n * C.NUM_CLASSES  # 10 B/base, not 40
+    assert len(board._ins["big"]) == 1
+    out = board.stitch("big")
+    assert out.startswith("A") and isinstance(out, str)
+
+
+def test_iter_inference_windows_slab_streaming(rng, tmp_path):
+    """Slab-limited HDF5 reads yield the same batches as whole-group
+    loads (VERDICT r2 task #7: genome-scale groups must stream)."""
+    from roko_tpu.data.hdf5 import DataWriter, iter_inference_windows
+
+    n = 23
+    pos = np.stack(
+        [np.stack([np.arange(C.WINDOW_COLS) + i, np.zeros(C.WINDOW_COLS)], 1)
+         for i in range(n)]
+    ).astype(np.int64)
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)).astype(np.uint8)
+    path = str(tmp_path / "s.hdf5")
+    with DataWriter(path, infer=True) as w:
+        w.write_contigs([("c", "ACGT" * 50)])
+        w.store("c", pos, X, None)
+
+    whole = list(iter_inference_windows(path, 8, slab=10_000))
+    slabbed = list(iter_inference_windows(path, 8, slab=5))
+    assert len(whole) == len(slabbed) == 3
+    for (c1, p1, x1), (c2, p2, x2) in zip(whole, slabbed):
+        assert c1 == c2
+        np.testing.assert_array_equal(p1, p2)
+        np.testing.assert_array_equal(x1, x2)
