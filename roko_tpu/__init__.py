@@ -13,9 +13,9 @@ A from-scratch reimplementation of the capabilities of lbcb-sci/roko
 
 Pipeline (mirrors the reference's three CLI stages, ref: README.md:7):
 
-    roko-tpu features  FASTA + BAM [+ truth BAM]  ->  features.hdf5
-    roko-tpu train     features.hdf5 dir          ->  orbax checkpoints
-    roko-tpu infer     features.hdf5 + checkpoint ->  polished.fasta
+    roko-tpu features   FASTA + BAM [+ truth BAM]  ->  features.hdf5
+    roko-tpu train      features.hdf5 dir          ->  orbax checkpoints
+    roko-tpu inference  features.hdf5 + checkpoint ->  polished.fasta
 """
 
 __version__ = "0.1.0"

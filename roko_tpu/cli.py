@@ -17,45 +17,104 @@ import sys
 from typing import List, Optional
 
 
+def _config_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--config",
+        default=None,
+        help="RokoConfig JSON file (RokoConfig.to_json layout); explicit "
+        "CLI flags override values from the file",
+    )
+
+
 def _mesh_args(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--dp", type=int, default=-1, help="data-parallel mesh axis (-1 = all devices)")
-    p.add_argument("--tp", type=int, default=1, help="tensor-parallel mesh axis")
-    p.add_argument("--sp", type=int, default=1, help="sequence-parallel mesh axis")
+    p.add_argument("--dp", type=int, default=None, help="data-parallel mesh axis (-1 = all devices)")
+    p.add_argument("--tp", type=int, default=None, help="tensor-parallel mesh axis")
+    p.add_argument("--sp", type=int, default=None, help="sequence-parallel mesh axis")
 
 
 def _model_args(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--model-kind", choices=("gru", "transformer"), default="gru")
-    p.add_argument("--hidden-size", type=int, default=128)
-    p.add_argument("--num-layers", type=int, default=3)
-    p.add_argument("--compute-dtype", default="float32", choices=("float32", "bfloat16"))
-    p.add_argument("--use-pallas", action="store_true", help="fused Pallas GRU kernel on TPU")
+    p.add_argument("--model-kind", choices=("gru", "transformer"), default=None)
+    p.add_argument("--hidden-size", type=int, default=None)
+    p.add_argument("--num-layers", type=int, default=None)
+    p.add_argument("--compute-dtype", default=None, choices=("float32", "bfloat16"))
+    p.add_argument("--use-pallas", action="store_true", default=None,
+                   help="fused Pallas GRU kernels on TPU (inference + training)")
+    p.add_argument("--d-model", type=int, default=None,
+                   help="transformer width (default 2*hidden-size)")
+    p.add_argument("--num-heads", type=int, default=None)
+    p.add_argument("--mlp-ratio", type=int, default=None)
+
+
+def _window_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--window-rows", type=int, default=None, help="pileup rows per window")
+    p.add_argument("--window-cols", type=int, default=None, help="pileup columns per window")
+    p.add_argument("--window-stride", type=int, default=None)
+    p.add_argument("--region-size", type=int, default=None)
+    p.add_argument("--region-overlap", type=int, default=None)
+    p.add_argument("--min-mapq", type=int, default=None, help="read filter: minimum mapping quality")
+    p.add_argument("--filter-flag", type=int, default=None, help="read filter: SAM flag mask to drop")
+    p.add_argument("--no-proper-pair", action="store_true", default=None,
+                   help="read filter: drop the proper-pair requirement for paired reads")
 
 
 def _build_config(args: argparse.Namespace):
-    from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig, TrainConfig
+    """Layered config: built-in defaults < --config JSON < explicit CLI
+    flags (a flag left at its None default defers to the layer below)."""
+    import dataclasses
 
-    model = ModelConfig(
-        kind=getattr(args, "model_kind", "gru"),
-        hidden_size=getattr(args, "hidden_size", 128),
-        num_layers=getattr(args, "num_layers", 3),
-        compute_dtype=getattr(args, "compute_dtype", "float32"),
-        use_pallas=getattr(args, "use_pallas", False),
-        d_model=2 * getattr(args, "hidden_size", 128),
+    from roko_tpu.config import RokoConfig
+
+    base = RokoConfig()
+    cfg_path = getattr(args, "config", None)
+    if cfg_path:
+        with open(cfg_path) as f:
+            base = RokoConfig.from_json(f.read())
+
+    def over(dc, **names):
+        """dataclasses.replace with only the CLI-set (non-None) fields."""
+        got = {
+            field: getattr(args, attr, None) for field, attr in names.items()
+        }
+        return dataclasses.replace(
+            dc, **{k: v for k, v in got.items() if v is not None}
+        )
+
+    window = over(
+        base.window,
+        rows="window_rows", cols="window_cols", stride="window_stride",
     )
-    train = TrainConfig(
-        batch_size=getattr(args, "b", 128),
-        epochs=getattr(args, "epochs", 100),
-        lr=getattr(args, "lr", 1e-4),
-        patience=getattr(args, "patience", 7),
-        seed=getattr(args, "seed", 0),
-        in_memory=getattr(args, "memory", True),
+    region = over(base.region, size="region_size", overlap="region_overlap")
+    read_filter = over(
+        base.read_filter, min_mapq="min_mapq", filter_flag="filter_flag"
     )
-    mesh = MeshConfig(
-        dp=getattr(args, "dp", -1),
-        tp=getattr(args, "tp", 1),
-        sp=getattr(args, "sp", 1),
+    if getattr(args, "no_proper_pair", None):
+        read_filter = dataclasses.replace(read_filter, require_proper_pair=False)
+
+    model = over(
+        base.model,
+        kind="model_kind", hidden_size="hidden_size", num_layers="num_layers",
+        compute_dtype="compute_dtype", use_pallas="use_pallas",
+        d_model="d_model", num_heads="num_heads", mlp_ratio="mlp_ratio",
     )
-    return RokoConfig(model=model, train=train, mesh=mesh)
+    # the transformer head is shared with the GRU family, so d_model
+    # tracks 2*hidden unless explicitly set
+    if getattr(args, "hidden_size", None) is not None and getattr(args, "d_model", None) is None:
+        model = dataclasses.replace(model, d_model=2 * model.hidden_size)
+    # the model consumes the window geometry (fc1 width, positional table)
+    model = dataclasses.replace(
+        model, window_rows=window.rows, window_cols=window.cols
+    )
+
+    train = over(
+        base.train,
+        batch_size="b", epochs="epochs", lr="lr", patience="patience",
+        seed="seed", in_memory="memory",
+    )
+    mesh = over(base.mesh, dp="dp", tp="tp", sp="sp")
+    return RokoConfig(
+        window=window, read_filter=read_filter, region=region,
+        model=model, train=train, mesh=mesh,
+    )
 
 
 def cmd_features(args: argparse.Namespace) -> int:
@@ -68,6 +127,7 @@ def cmd_features(args: argparse.Namespace) -> int:
         bam_y=args.Y,
         workers=args.t,
         seed=args.seed,
+        config=_build_config(args),
     )
     print(f"wrote {n} windows to {args.o}")
     return 0
@@ -96,7 +156,12 @@ def cmd_inference(args: argparse.Namespace) -> int:
     else:
         params = load_params(args.model)
     polish_to_fasta(
-        args.data, params, args.out, cfg, batch_size=args.b,
+        args.data, params, args.out, cfg,
+        batch_size=args.b if args.b is not None else cfg.train.batch_size,
+        # reference parity: --t sized the torch DataLoader worker pool
+        # (ref: roko/inference.py:162); here the loader is a bounded
+        # prefetch-thread pipeline, so --t sets its queue depth
+        prefetch=max(2, args.t),
         trace_dir=args.trace_dir,
     )
     print(f"wrote polished contigs to {args.out}")
@@ -119,7 +184,13 @@ def cmd_convert(args: argparse.Namespace) -> int:
 def cmd_bench(args: argparse.Namespace) -> int:
     from roko_tpu.benchmark import main as bench_main
 
-    bench_main()
+    argv: List[str] = []
+    if args.train:
+        argv.append("--train")
+    argv += ["--batch", str(args.b)]
+    if args.out:
+        argv += ["--out", args.out]
+    bench_main(argv)
     return 0
 
 
@@ -136,17 +207,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--Y", default=None, help="truth-to-draft BAM (training mode)")
     p.add_argument("--t", type=int, default=1, help="worker processes")
     p.add_argument("--seed", type=int, default=0, help="row-sampling RNG seed")
+    _config_arg(p)
+    _window_args(p)
     p.set_defaults(fn=cmd_features)
 
     p = sub.add_parser("train", help="features HDF5 -> checkpoints")
     p.add_argument("train", help="training HDF5 file or directory")
     p.add_argument("out", help="checkpoint output directory")
     p.add_argument("--val", default=None, help="validation HDF5 file or directory")
-    p.add_argument("--b", type=int, default=128, help="global batch size")
-    p.add_argument("--epochs", type=int, default=100)
-    p.add_argument("--lr", type=float, default=1e-4)
-    p.add_argument("--patience", type=int, default=7)
-    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--b", type=int, default=None, help="global batch size (default 128)")
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--patience", type=int, default=None)
+    p.add_argument("--seed", type=int, default=None)
     p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace of the first epoch here")
     p.add_argument(
         "--no-resume",
@@ -158,39 +231,49 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--memory",
         action="store_true",
-        default=True,
+        default=None,
         help="keep dataset in host RAM (ref --memory; the default)",
     )
     p.add_argument(
         "--no-memory",
         dest="memory",
         action="store_false",
+        default=None,  # shared dest: None = neither flag given
         help="stream batches from HDF5 instead of loading into RAM",
     )
+    _config_arg(p)
     _model_args(p)
     _mesh_args(p)
+    _window_args(p)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("inference", help="features HDF5 + checkpoint -> polished FASTA")
     p.add_argument("data", help="inference HDF5")
     p.add_argument("model", help="checkpoint dir, saved params, or torch .pth")
     p.add_argument("out", help="output FASTA path")
-    p.add_argument("--b", type=int, default=128, help="batch size")
+    p.add_argument("--b", type=int, default=None, help="batch size (default 128)")
     p.add_argument(
-        "--t", type=int, default=0, help="accepted for reference parity (unused)"
+        "--t", type=int, default=2,
+        help="loader prefetch depth (reference parity: DataLoader workers)",
     )
     p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace here")
+    _config_arg(p)
     _model_args(p)
     _mesh_args(p)
+    _window_args(p)
     p.set_defaults(fn=cmd_inference)
 
     p = sub.add_parser("convert", help="torch .pth -> native checkpoint")
     p.add_argument("torch_ckpt")
     p.add_argument("out")
+    _config_arg(p)
     _model_args(p)
     p.set_defaults(fn=cmd_convert)
 
     p = sub.add_parser("bench", help="print the benchmark JSON line")
+    p.add_argument("--train", action="store_true", help="also time training steps")
+    p.add_argument("--b", type=int, default=512, help="benchmark batch size")
+    p.add_argument("--out", default=None, help="write full results JSON here")
     p.set_defaults(fn=cmd_bench)
 
     return parser

@@ -56,6 +56,11 @@ class ModelConfig:
 
     kind: str = "gru"  # "gru" | "transformer"
     embed_vocab: int = C.FEATURE_VOCAB
+    #: window geometry the model consumes — kept in ModelConfig (not just
+    #: WindowConfig) because it sizes fc1 and the positional table; the
+    #: CLI syncs it from WindowConfig for non-default geometries
+    window_rows: int = C.WINDOW_ROWS
+    window_cols: int = C.WINDOW_COLS
     embed_dim: int = 50
     read_mlp: Tuple[int, ...] = (100, 10)
     hidden_size: int = 128
@@ -91,6 +96,8 @@ class TrainConfig:
     keep_checkpoints: int = 3
     #: number of host prefetch batches queued ahead of the device
     prefetch: int = 2
+    #: in-epoch heartbeat: log rate/ETA every N steps (0 disables)
+    log_every_steps: int = 200
 
 
 @dataclass(frozen=True)

@@ -167,6 +167,16 @@ def generate_train(job: _Job):
     )
 
 
+def _use_thread_pool(inference: bool) -> bool:
+    """Threads beat processes only when the per-region work releases the
+    GIL: the C++ extractor does, but train-mode labeling
+    (``generate_train``) is GIL-bound Python around it — a ThreadPool
+    there loses most multi-core scaling (ADVICE r1 (d))."""
+    from roko_tpu.features.backend import _native_available
+
+    return inference and _native_available()
+
+
 def run_features(
     ref_path: str,
     bam_x: str,
@@ -176,8 +186,11 @@ def run_features(
     seed: int = 0,
     config: Optional[RokoConfig] = None,
     flush_every: int = 10,
+    log=print,
 ) -> int:
     """Generate a features HDF5. Returns the number of windows written."""
+    import time
+
     config = config or RokoConfig()
     inference = bam_y is None
     refs = read_fasta(ref_path)
@@ -204,31 +217,40 @@ def run_features(
         if workers <= 1:
             results = map(func, jobs)
             pool = None
+        elif _use_thread_pool(inference):
+            # the C++ extractor releases the GIL, so threads give
+            # full parallelism with zero IPC (results stay in-process
+            # — no pickling of the window buffers)
+            from multiprocessing.pool import ThreadPool
+
+            pool = ThreadPool(processes=workers)
+            results = pool.imap(func, jobs)
         else:
-            from roko_tpu.features.backend import _native_available
-
-            if _native_available():
-                # the C++ extractor releases the GIL, so threads give
-                # full parallelism with zero IPC (results stay in-process
-                # — no pickling of the window buffers)
-                from multiprocessing.pool import ThreadPool
-
-                pool = ThreadPool(processes=workers)
-            else:
-                pool = multiprocessing.Pool(processes=workers)
+            pool = multiprocessing.Pool(processes=workers)
             results = pool.imap(func, jobs)
 
+        t0 = time.perf_counter()
         try:
-            finished = 0
+            done = 0
             for result in results:
+                done += 1
+                # progress heartbeat: a 5-species feature run is hours —
+                # report every flush batch (ref printed per region,
+                # roko/features.py:139; one line per flush is quieter)
+                if done % flush_every == 0:
+                    dt = time.perf_counter() - t0
+                    rate = done / max(dt, 1e-9)
+                    log(
+                        f"features: {done}/{len(jobs)} regions, "
+                        f"{total} windows "
+                        f"({rate:.1f} regions/s, eta {(len(jobs) - done) / max(rate, 1e-9):.0f}s)"
+                    )
+                    data.write()
                 if not result:
                     continue
                 contig, p, x, y = result
                 data.store(contig, p, x, y)
                 total += len(p)
-                finished += 1
-                if finished % flush_every == 0:
-                    data.write()
             data.write()
         finally:
             if pool is not None:

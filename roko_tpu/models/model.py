@@ -75,7 +75,7 @@ class RokoModel:
             "embedding": jax.random.normal(
                 keys[0], (cfg.embed_vocab, cfg.embed_dim), jnp.float32
             ),
-            "fc1": _dense_params(keys[1], C.WINDOW_ROWS, cfg.read_mlp[0]),
+            "fc1": _dense_params(keys[1], cfg.window_rows, cfg.read_mlp[0]),
             "fc2": _dense_params(keys[2], cfg.read_mlp[0], cfg.read_mlp[1]),
             "head": _dense_params(
                 keys[3], 2 * cfg.hidden_size, cfg.num_classes
@@ -137,7 +137,7 @@ class RokoModel:
         # [B,90,50,10] -> [B,90,500]; row-major flatten matches the
         # reference's .reshape(-1, 90, 500)
         B = h.shape[0]
-        h = h.reshape(B, C.WINDOW_COLS, cfg.gru_in_size)
+        h = h.reshape(B, cfg.window_cols, cfg.gru_in_size)
 
         if cfg.kind == "gru":
             h = self.gru.apply(

@@ -109,13 +109,12 @@ def transformer_init(rng: jax.Array, cfg: ModelConfig) -> Params:
     if d % cfg.num_heads:
         raise ValueError(f"d_model {d} not divisible by {cfg.num_heads} heads")
     keys = jax.random.split(rng, cfg.num_layers + 3)
-    from roko_tpu import constants as C
 
     return {
         "in_proj": _dense_init(keys[0], cfg.gru_in_size, d),
         # learned positional embedding over the pileup-column axis
         "pos_embed": 0.02
-        * jax.random.normal(keys[1], (C.WINDOW_COLS, d), jnp.float32),
+        * jax.random.normal(keys[1], (cfg.window_cols, d), jnp.float32),
         "layers": tuple(
             _layer_init(keys[2 + i], d, cfg.mlp_ratio * d)
             for i in range(cfg.num_layers)

@@ -23,7 +23,9 @@ def initialize(
     """Initialise ``jax.distributed`` when running multi-host.
 
     With no arguments, TPU-VM metadata autodetects the topology
-    (``jax.distributed.initialize()``'s default path). Returns True if
+    (``jax.distributed.initialize()``'s default path). Off-TPU (e.g. the
+    2-process CPU test) the topology comes from ``ROKO_COORDINATOR``,
+    ``ROKO_NUM_PROCESSES`` and ``ROKO_PROCESS_ID``. Returns True if
     distributed mode was initialised, False for single-host runs (no
     coordinator reachable / single process) — callers can proceed
     either way.
@@ -32,6 +34,10 @@ def initialize(
     # that could touch jax state: even jax.process_count() initialises
     # the local backend, after which distributed init is impossible.
     explicit = coordinator_address or os.environ.get("ROKO_COORDINATOR")
+    if num_processes is None and os.environ.get("ROKO_NUM_PROCESSES"):
+        num_processes = int(os.environ["ROKO_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("ROKO_PROCESS_ID"):
+        process_id = int(os.environ["ROKO_PROCESS_ID"])
     # TPU_WORKER_HOSTNAMES is set even on single-worker VMs; only a
     # comma-separated list indicates an actual pod slice
     workers = os.environ.get("TPU_WORKER_HOSTNAMES", "")
@@ -45,6 +51,16 @@ def initialize(
         return False
 
     import jax
+
+    # idempotent: train() and run_inference() both call this, and
+    # re-initialising after the backend is live raises
+    try:
+        from jax._src.distributed import global_state as _gs
+
+        if getattr(_gs, "client", None) is not None:
+            return jax.process_count() > 1
+    except ImportError:  # pragma: no cover - jax internals moved
+        pass
 
     try:
         jax.distributed.initialize(

@@ -78,3 +78,22 @@ def shard_batch(mesh: Mesh, batch):
     mesh sharded over dp. Batch size must divide by the dp extent."""
     sharding = data_sharding(mesh)
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+
+def put_replicated(tree, mesh: Mesh):
+    """Replicate a host pytree over the whole mesh, multi-host safe.
+
+    ``device_put`` onto a sharding that spans non-addressable devices
+    raises on pods; ``make_array_from_process_local_data`` assembles the
+    global replicated array from each process's full local copy instead
+    (every process must hold identical values — true for PRNG-derived
+    init and for checkpoint restores)."""
+    repl = replicated_sharding(mesh)
+    if jax.process_count() == 1:
+        return jax.device_put(tree, repl)
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(
+            repl, np.asarray(a), np.shape(a)
+        ),
+        tree,
+    )

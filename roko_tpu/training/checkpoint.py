@@ -76,6 +76,23 @@ class CheckpointManager:
     def restore_best(self, like=None) -> Optional[Dict[str, Any]]:
         return self._restore(self._mgr.best_step(), like)
 
+    def latest_keys(self) -> Optional[set]:
+        """Top-level key names of the most recent checkpoint (the
+        ``latest`` dir if present, else the newest numbered step), or
+        None when no checkpoint exists. Resume builds its restore
+        target from the on-disk layout instead of guessing layouts via
+        exception handling (ADVICE r1 (a))."""
+        if os.path.exists(self._latest_path):
+            self._ckptr.wait_until_finished()
+            meta = self._ckptr.metadata(self._latest_path)
+            return set(meta.item_metadata.tree.keys())
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        meta = self._mgr.item_metadata(step)
+        tree = getattr(getattr(meta, "item_metadata", meta), "tree", meta)
+        return set(tree.keys())
+
     def best_step(self) -> Optional[int]:
         return self._mgr.best_step()
 
@@ -87,20 +104,25 @@ class CheckpointManager:
 
 
 def load_params(path: str) -> Dict[str, Any]:
-    """Load params from either a checkpoint directory (best step) or a
-    single saved-state dir; returns the params pytree."""
+    """Load params from a checkpoint directory (best step, falling back
+    to ``latest`` when no best-k step exists — e.g. a dir holding only
+    the always-current ``latest``, ADVICE r1 (c)) or a single
+    saved-state dir; returns the params pytree."""
     path = os.path.abspath(path)
-    if os.path.isdir(path) and any(
-        name.isdigit() for name in os.listdir(path)
-    ):
-        mgr = CheckpointManager(path)
-        try:
-            state = mgr.restore_best()
-        finally:
-            mgr.close()
-        if state is None:
-            raise FileNotFoundError(f"no checkpoints under {path}")
-        return state["params"]
+    if os.path.isdir(path):
+        entries = os.listdir(path)
+        has_steps = any(name.isdigit() for name in entries)
+        if has_steps or "latest" in entries:
+            mgr = CheckpointManager(path)
+            try:
+                state = mgr.restore_best() if has_steps else None
+                if state is None:
+                    state = mgr.restore_latest()
+            finally:
+                mgr.close()
+            if state is None:
+                raise FileNotFoundError(f"no checkpoints under {path}")
+            return state["params"]
     ckptr = ocp.StandardCheckpointer()
     state = ckptr.restore(path)
     return state["params"] if "params" in state else state

@@ -32,6 +32,7 @@ from roko_tpu.parallel.mesh import (
     AXIS_DP,
     data_sharding,
     make_mesh,
+    put_replicated,
     replicated_sharding,
 )
 from roko_tpu.training import checkpoint as ckpt_lib
@@ -127,13 +128,48 @@ def make_eval_step(model: RokoModel, mesh: Mesh) -> Callable:
     return step
 
 
-def evaluate(eval_step, params, dataset, batch_size, mesh) -> Tuple[float, float]:
-    """Return (mean position accuracy, mean per-window loss)."""
+def make_placer(mesh: Mesh) -> Callable:
+    """Host->device placement for a (x, y, w)-style tuple of global
+    batches, correct on multi-host pods.
+
+    Single process: a plain ``device_put`` onto the dp sharding. With
+    ``jax.process_count() > 1`` a host cannot ``device_put`` onto a mesh
+    spanning non-addressable devices; instead every process slices its
+    own rows out of the (identically generated) global batch and wraps
+    them with ``jax.make_array_from_process_local_data``, which
+    assembles the logically-global array from per-process shards
+    (SURVEY.md §5.8; VERDICT r2 task #3). Row-slice <-> device locality
+    holds because ``jax.devices()`` orders devices process-major and the
+    mesh's dp axis follows that order."""
     sharding = data_sharding(mesh)
+    nproc = jax.process_count()
+    pid = jax.process_index()
 
     def place(batch):
-        x, y, w = batch
-        return tuple(jax.device_put(a, sharding) for a in (x, y, w))
+        if nproc == 1:
+            return tuple(jax.device_put(a, sharding) for a in batch)
+        out = []
+        for a in batch:
+            if a.shape[0] % nproc:
+                raise ValueError(
+                    f"global batch {a.shape[0]} not divisible by "
+                    f"{nproc} processes"
+                )
+            per = a.shape[0] // nproc
+            local = a[pid * per : (pid + 1) * per]
+            out.append(
+                jax.make_array_from_process_local_data(
+                    sharding, local, a.shape
+                )
+            )
+        return tuple(out)
+
+    return place
+
+
+def evaluate(eval_step, params, dataset, batch_size, mesh) -> Tuple[float, float]:
+    """Return (mean position accuracy, mean per-window loss)."""
+    place = make_placer(mesh)
 
     correct = total = 0.0
     loss_sum = rows = 0.0
@@ -162,10 +198,23 @@ def train(
     """Full training run; returns the final state. Best-k checkpoints by
     validation accuracy land in ``out_dir`` (ref flow: roko/train.py:18-111).
 
-    Checkpoints carry optimizer state and step, so an interrupted run
-    restarts from its latest checkpoint when ``resume`` is set (the
-    early-stopping patience counter restarts; the reference had no
-    resume at all, SURVEY.md §5.3-5.4)."""
+    Checkpoints carry optimizer state, step, epoch and the
+    early-stopping counters, so an interrupted run resumes exactly (the
+    reference had no resume at all, SURVEY.md §5.3-5.4).
+
+    Multi-host pods: call-site needs nothing special — ``train()``
+    initialises ``jax.distributed`` when a pod topology is detected, the
+    mesh spans all hosts' devices, every process feeds its slice of the
+    global batch (``make_placer``), logging is primary-only, and every
+    process participates in checkpoint save/restore (the Orbax
+    multi-host contract: process 0 writes metadata, all processes write
+    their addressable shards — gating save on the primary would
+    deadlock sharded arrays)."""
+    from roko_tpu.parallel import distributed
+
+    distributed.initialize()  # no-op single host (SURVEY §5.8)
+    if not distributed.is_primary():
+        log = lambda s: None  # noqa: E731 — primary-only logging
     tcfg = cfg.train
     mesh = mesh or make_mesh(cfg.mesh)
     dp = mesh.shape[AXIS_DP]
@@ -191,55 +240,71 @@ def train(
     root = jax.random.PRNGKey(tcfg.seed)
     init_rng, dropout_rng = jax.random.split(root)
     state = create_state(model, tx, init_rng)
-    repl = replicated_sharding(mesh)
     state = TrainState(
-        jax.device_put(state.params, repl),
-        jax.device_put(state.opt_state, repl),
+        put_replicated(state.params, mesh),
+        put_replicated(state.opt_state, mesh),
         state.step,
     )
 
     train_step = make_train_step(model, tx, mesh)
     eval_step = make_eval_step(model, mesh)
-    sharding = data_sharding(mesh)
-
-    def place(batch):
-        x, y, w = batch
-        return tuple(jax.device_put(a, sharding) for a in (x, y, w))
+    place = make_placer(mesh)
 
     manager = ckpt_lib.CheckpointManager(out_dir, keep=tcfg.keep_checkpoints)
     best_acc, bad_epochs = -1.0, 0
     params, opt_state, step_no = state.params, state.opt_state, state.step
 
-    # the saved state carries the epoch explicitly — deriving it from
-    # step // steps_per_epoch would break on resume with a different
-    # batch size or dataset
-    ckpt_like = dict(state.as_dict(), epoch=jnp.zeros((), jnp.int32))
+    # the saved state carries the epoch and early-stopping counters
+    # explicitly — deriving the epoch from step // steps_per_epoch would
+    # break on resume with a different batch size or dataset, and a
+    # resume that forgot best_acc/bad_epochs would silently reset the
+    # patience window (ADVICE r1 (b))
+    full_template = dict(
+        state.as_dict(),
+        epoch=jnp.zeros((), jnp.int32),
+        early_stop={
+            "best_acc": jnp.zeros((), jnp.float32),
+            "bad_epochs": jnp.zeros((), jnp.int32),
+        },
+    )
     start_epoch = 0
     if resume:
-        try:
-            restored = manager.restore_latest(like=ckpt_like)
-        except Exception:
-            # pre-'epoch' checkpoint layout: restore the old structure
-            # and recover the epoch from the step count
-            restored = manager.restore_latest(like=state.as_dict())
-            if restored is not None:
-                steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
-                restored = dict(
-                    restored,
-                    epoch=jnp.asarray(
-                        int(restored["step"]) // steps_per_epoch - 1, jnp.int32
-                    ),
-                )
+        # build the restore target from the checkpoint's actual on-disk
+        # layout (older layouts lack 'epoch'/'early_stop') — a corrupt
+        # checkpoint now raises instead of being mistaken for a legacy
+        # layout (ADVICE r1 (a))
+        keys = manager.latest_keys()
+        if keys is not None:
+            like = {k: v for k, v in full_template.items() if k in keys}
+            restored = manager.restore_latest(like=like)
+        else:
+            restored = None
         if restored is not None:
-            params = jax.device_put(restored["params"], repl)
-            opt_state = jax.device_put(restored["opt_state"], repl)
+            params = put_replicated(restored["params"], mesh)
+            opt_state = put_replicated(restored["opt_state"], mesh)
             step_no = jnp.asarray(restored["step"], jnp.int32)
-            start_epoch = int(jax.device_get(restored["epoch"])) + 1
+            if "epoch" in restored:
+                start_epoch = int(jax.device_get(restored["epoch"])) + 1
+            else:  # pre-'epoch' layout: recover from the step count
+                steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
+                start_epoch = int(restored["step"]) // steps_per_epoch
+            if "early_stop" in restored:
+                es = jax.device_get(restored["early_stop"])
+                best_acc = float(es["best_acc"])
+                bad_epochs = int(es["bad_epochs"])
             log(
                 f"resumed from step {int(jax.device_get(step_no))} "
-                f"(epoch {start_epoch})"
+                f"(epoch {start_epoch}, best val_acc {best_acc:.5f}, "
+                f"{bad_epochs} stale epochs)"
             )
 
+    if val_ds is None:
+        # train-set accuracy is near-monotonic, so patience would never
+        # fire — or fire on noise; run the full epoch budget instead
+        # (VERDICT r2 weak #4)
+        log("no val set: early stopping disabled, running all epochs")
+
+    steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
     try:
         for epoch in range(start_epoch, tcfg.epochs):
             t0 = time.perf_counter()
@@ -270,6 +335,17 @@ def train(
                     step_no = step_no + 1
                     running = running + loss
                     n_batches += 1
+                    # in-epoch heartbeat: rate + ETA, no device sync (a
+                    # float(loss) here would stall the dispatch queue)
+                    if tcfg.log_every_steps and n_batches % tcfg.log_every_steps == 0:
+                        dt_so_far = time.perf_counter() - t0
+                        rate = n_batches / max(dt_so_far, 1e-9)
+                        eta = (steps_per_epoch - n_batches) / max(rate, 1e-9)
+                        log(
+                            f"  epoch {epoch} step {n_batches}/{steps_per_epoch} "
+                            f"({rate * tcfg.batch_size:.0f} windows/s, "
+                            f"eta {eta:.0f}s)"
+                        )
                 running = float(jax.device_get(running))
             dt = time.perf_counter() - t0
 
@@ -282,25 +358,42 @@ def train(
                 f"{n_batches * tcfg.batch_size / max(dt, 1e-9):.0f} windows/s)"
             )
 
+            # update the patience window BEFORE saving so a resumed run
+            # restores the exact early-stopping state (ADVICE r1 (b))
+            if acc > best_acc:
+                best_acc, bad_epochs = acc, 0
+            else:
+                bad_epochs += 1
+
+            # scalar bookkeeping must be globally-replicated arrays, not
+            # host-local ones — orbax refuses host-local jax.Arrays in a
+            # multi-host save
+            extras = put_replicated(
+                {
+                    "step": np.asarray(jax.device_get(step_no), np.int32),
+                    "epoch": np.asarray(epoch, np.int32),
+                    "early_stop": {
+                        "best_acc": np.asarray(best_acc, np.float32),
+                        "bad_epochs": np.asarray(bad_epochs, np.int32),
+                    },
+                },
+                mesh,
+            )
             manager.save(
                 int(jax.device_get(step_no)),
                 {
                     "params": params,
                     "opt_state": opt_state,
-                    "step": step_no,
-                    "epoch": jnp.asarray(epoch, jnp.int32),
+                    **extras,
                 },
                 acc,
             )
 
-            # early stopping, patience on val accuracy (ref: roko/train.py:74-80)
-            if acc > best_acc:
-                best_acc, bad_epochs = acc, 0
-            else:
-                bad_epochs += 1
-                if bad_epochs >= tcfg.patience:
-                    log(f"early stop at epoch {epoch} (best val_acc {best_acc:.5f})")
-                    break
+            # early stopping, patience on val accuracy (ref:
+            # roko/train.py:74-80); only meaningful with a real val set
+            if val_ds is not None and bad_epochs >= tcfg.patience:
+                log(f"early stop at epoch {epoch} (best val_acc {best_acc:.5f})")
+                break
     finally:
         manager.close()
 

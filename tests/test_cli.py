@@ -71,3 +71,75 @@ def test_cli_features_train_inference(tiny_project, capsys):
     assert rc == 0
     polished = read_fasta(str(root / "polished.fasta"))
     assert polished and polished[0][0] == "ctg"
+
+
+def test_cli_config_file_layering(tmp_path):
+    """--config JSON is the base layer; explicit CLI flags override it;
+    untouched flags defer to it."""
+    from roko_tpu.config import (
+        MeshConfig, ModelConfig, RokoConfig, TrainConfig, WindowConfig,
+    )
+
+    cfg = RokoConfig(
+        window=WindowConfig(rows=120, cols=60),
+        model=ModelConfig(hidden_size=32, num_layers=2),
+        train=TrainConfig(batch_size=64, lr=3e-3),
+        mesh=MeshConfig(dp=4, tp=2),
+    )
+    path = tmp_path / "cfg.json"
+    path.write_text(cfg.to_json())
+
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args(
+        ["train", "in/", "out/", "--config", str(path), "--b", "16"]
+    )
+    built = _build_config(args)
+    assert built.train.batch_size == 16  # CLI wins
+    assert built.train.lr == 3e-3  # file wins over built-in default
+    assert built.model.hidden_size == 32 and built.mesh.tp == 2
+    # the model follows the window geometry from the file
+    assert built.window.rows == 120
+    assert built.model.window_rows == 120 and built.model.window_cols == 60
+
+
+def test_cli_nondefault_window_geometry_end_to_end(tiny_project, tmp_path):
+    """A non-default pileup geometry (--window-rows/--window-cols) flows
+    through features -> train -> inference (VERDICT r2 task #8): the
+    extractor emits the requested shapes and the model sizes fc1 and the
+    reshape off the config, not the global constants."""
+    import h5py
+
+    root = tiny_project
+    geo = ["--window-rows", "100", "--window-cols", "45", "--window-stride", "15"]
+    rc = main([
+        "features", str(root / "draft.fasta"), str(root / "reads.bam"),
+        str(tmp_path / "train_g.hdf5"), "--Y", str(root / "truth.bam"),
+        "--seed", "5", *geo,
+    ])
+    assert rc == 0
+    with h5py.File(tmp_path / "train_g.hdf5") as f:
+        g = [k for k in f.keys() if k != "contigs"][0]
+        assert f[g]["examples"].shape[1:] == (100, 45)
+
+    rc = main([
+        "features", str(root / "draft.fasta"), str(root / "reads.bam"),
+        str(tmp_path / "infer_g.hdf5"), "--seed", "5", *geo,
+    ])
+    assert rc == 0
+
+    rc = main([
+        "train", str(tmp_path / "train_g.hdf5"), str(tmp_path / "ckpt_g"),
+        "--b", "16", "--epochs", "1", "--lr", "1e-3",
+        "--hidden-size", "16", "--num-layers", "1", "--dp", "8", *geo,
+    ])
+    assert rc == 0
+
+    rc = main([
+        "inference", str(tmp_path / "infer_g.hdf5"), str(tmp_path / "ckpt_g"),
+        str(tmp_path / "polished_g.fasta"), "--b", "16",
+        "--hidden-size", "16", "--num-layers", "1", "--dp", "8", *geo,
+    ])
+    assert rc == 0
+    polished = read_fasta(str(tmp_path / "polished_g.fasta"))
+    assert polished and polished[0][0] == "ctg"

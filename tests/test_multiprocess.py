@@ -1,0 +1,111 @@
+"""Two-process data path over jax.distributed on localhost CPU
+(VERDICT r2 task #3): the global mesh spans both processes' virtual
+devices, every process feeds its slice of the global batch, checkpoints
+are written cooperatively, and pod inference shards contigs and merges
+the FASTA parts."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.data.hdf5 import DataWriter
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import sys as _s
+if "jax" in _s.modules:
+    import jax; jax.config.update("jax_platforms", "cpu")
+
+root, pid, port, tmp = sys.argv[1:5]
+sys.path.insert(0, root)
+os.environ["ROKO_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["ROKO_NUM_PROCESSES"] = "2"
+os.environ["ROKO_PROCESS_ID"] = pid
+
+import jax
+from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig, TrainConfig
+from roko_tpu.training.loop import train
+from roko_tpu.infer import polish_to_fasta
+
+cfg = RokoConfig(
+    model=ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1),
+    train=TrainConfig(batch_size=16, epochs=1, lr=1e-2),
+    mesh=MeshConfig(dp=8),
+)
+state = train(cfg, f"{tmp}/train.hdf5", f"{tmp}/ckpt")
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+
+params = jax.device_get(state.params)
+polish_to_fasta(
+    f"{tmp}/infer.hdf5", params, f"{tmp}/polished.fasta", cfg, batch_size=16
+)
+print(f"WORKER_{pid}_OK")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_train_and_polish(rng, tmp_path):
+    n = 32
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)).astype(
+        np.uint8
+    )
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    pos = [
+        np.stack([np.arange(C.WINDOW_COLS) + 7 * (i % 3), np.zeros(C.WINDOW_COLS)], 1)
+        for i in range(n)
+    ]
+    contigs = [("ctgA", "ACGT" * 60), ("ctgB", "TTGCA" * 50)]
+    with DataWriter(str(tmp_path / "train.hdf5"), infer=False) as w:
+        w.write_contigs(contigs)
+        w.store("ctgA", pos, list(X), list(Y))
+    with DataWriter(str(tmp_path / "infer.hdf5"), infer=True) as w:
+        w.write_contigs(contigs)
+        half = n // 2
+        w.store("ctgA", pos[:half], list(X[:half]), None)
+        w.store("ctgB", pos[half:], list(X[half:]), None)
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = _free_port()
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), root, str(p), str(port), str(tmp_path)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for p in (0, 1)
+    ]
+    outs = [p.communicate(timeout=840)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    assert "WORKER_0_OK" in outs[0] and "WORKER_1_OK" in outs[1]
+
+    # cooperative checkpoint exists and both contigs made it into the
+    # merged FASTA (each process polished one contig)
+    from roko_tpu.io.fasta import read_fasta
+
+    assert (tmp_path / "ckpt" / "latest").exists()
+    polished = dict(read_fasta(str(tmp_path / "polished.fasta")))
+    assert set(polished) == {"ctgA", "ctgB"}
+    assert not (tmp_path / "polished.fasta.part0").exists()  # parts cleaned

@@ -92,7 +92,11 @@ def test_train_loop_learns_and_checkpoints(rng, tmp_path):
     # loss decreased across epochs
     import re
 
-    losses = [float(re.search(r"train_loss ([0-9.]+)", l).group(1)) for l in logs[1:]]
+    losses = [
+        float(m.group(1))
+        for m in (re.search(r"train_loss ([0-9.]+)", l) for l in logs)
+        if m
+    ]
     assert losses[-1] < losses[0]
 
     params = load_params(str(tmp_path / "ckpt"))
@@ -153,8 +157,145 @@ def test_train_resume_from_checkpoint(rng, tmp_path):
         cfg5, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
         log=logs2.append,
     )
-    assert any("(epoch 4)" in l for l in logs2)
+    assert any("epoch 4" in l for l in logs2)
     assert int(jax.device_get(state.step)) == 16 + 2  # one epoch of 2 steps
+
+
+def test_resume_restores_early_stop_state(rng, tmp_path):
+    """best_acc/bad_epochs ride in the checkpoint so a resumed run keeps
+    its patience window instead of resetting it (ADVICE r1 (b))."""
+    X, Y = _window_batch(rng, 64)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=2, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    train(
+        cfg,
+        str(tmp_path / "train.hdf5"),
+        str(tmp_path / "ckpt"),
+        val_path=str(tmp_path / "train.hdf5"),
+    )
+
+    from roko_tpu.training.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    keys = mgr.latest_keys()
+    restored = mgr.restore_latest()
+    mgr.close()
+    assert "early_stop" in keys and "epoch" in keys
+    assert float(restored["early_stop"]["best_acc"]) > 0
+
+    cfg3 = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=3, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    train(
+        cfg3,
+        str(tmp_path / "train.hdf5"),
+        str(tmp_path / "ckpt"),
+        val_path=str(tmp_path / "train.hdf5"),
+        log=logs.append,
+    )
+    resumed = [l for l in logs if "resumed" in l]
+    assert resumed and "best val_acc" in resumed[0]
+    assert "best val_acc -1" not in resumed[0]  # state actually restored
+
+
+def test_resume_legacy_layout_without_epoch(rng, tmp_path):
+    """A checkpoint written by an older layout (params/opt_state/step
+    only) still resumes, with the epoch recovered from the step count —
+    layout detection reads the on-disk keys instead of guessing via a
+    broad except (ADVICE r1 (a))."""
+    import optax
+
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.training.checkpoint import CheckpointManager
+    from roko_tpu.training.loop import create_state
+
+    X, Y = _window_batch(rng, 64)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+
+    model = RokoModel(TINY)
+    tx = optax.adam(1e-2)
+    state = create_state(model, tx, jax.random.PRNGKey(0))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    # legacy layout: no epoch, no early_stop; step 8 == 2 epochs of 4
+    legacy = dict(state.as_dict(), step=jnp.asarray(8, jnp.int32))
+    mgr.save(8, legacy, val_acc=0.5)
+    mgr.close()
+
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=3, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    train(cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"), log=logs.append)
+    assert any("resumed from step 8 (epoch 2" in l for l in logs)
+
+
+def test_no_val_disables_early_stopping(rng, tmp_path):
+    """Without --val, patience must not fire on the near-monotonic
+    train-set accuracy: the full epoch budget runs (VERDICT r2 weak #4)."""
+    X, Y = _window_batch(rng, 32)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=4, lr=1e-6, patience=1),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    state = train(
+        cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+    assert any("early stopping disabled" in l for l in logs)
+    # lr tiny -> accuracy flat -> patience=1 would have stopped after
+    # epoch 1 if it were active; all 4 epochs must run
+    assert int(jax.device_get(state.step)) == 4 * 2
+
+
+def test_in_epoch_heartbeat(rng, tmp_path):
+    """log_every_steps emits rate/ETA lines inside an epoch."""
+    X, Y = _window_batch(rng, 64)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=1, lr=1e-2, log_every_steps=2),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    train(cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"), log=logs.append)
+    beats = [l for l in logs if "step 2/4" in l]
+    assert beats and "eta" in beats[0]
+
+
+def test_load_params_latest_only_dir(rng, tmp_path):
+    """A checkpoint dir holding only the always-current ``latest`` (no
+    numbered best-k steps) must load, not fail (ADVICE r1 (c))."""
+    import shutil
+
+    import optax
+
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.training.checkpoint import CheckpointManager, load_params
+    from roko_tpu.training.loop import create_state
+
+    model = RokoModel(TINY)
+    state = create_state(model, optax.adam(1e-2), jax.random.PRNGKey(0))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(4, state.as_dict(), val_acc=0.5)
+    mgr.close()
+    for entry in (tmp_path / "ckpt").iterdir():
+        if entry.name.isdigit():
+            shutil.rmtree(entry)
+
+    params = load_params(str(tmp_path / "ckpt"))
+    assert "embedding" in params
 
 
 def test_stage_timer_and_trace():
