@@ -1,7 +1,11 @@
 """Repo-root benchmark entry: prints one JSON line
-{"metric", "value", "unit", "vs_baseline"} (see roko_tpu/benchmark.py)."""
-
-from roko_tpu.benchmark import main
+{"metric", "value", "unit", "vs_baseline", "detail": {...}} (see
+roko_tpu/benchmark.py)."""
 
 if __name__ == "__main__":
+    from roko_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+    from roko_tpu.benchmark import main
+
     main()

@@ -73,6 +73,46 @@ def gru_direction(
     return _gru_scan(x_proj, h0, params["w_hh"], params["b_hh"], reverse)
 
 
+def bidir_layer(layer: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """Both directions of one layer in a SINGLE ``lax.scan``,
+    [B,T,in] -> [B,T,2H] (fwd ++ bwd on the feature axis).
+
+    The backward direction's hoisted input projection is time-reversed
+    so both recurrences run forward in scan time; the per-step hidden
+    matmul becomes one batched ``[2,B,H] x [2,H,3H]`` einsum. Halves the
+    scan count per forward (3 instead of 6) and doubles the per-step
+    MXU work — the serial chain is latency-bound, so fewer/fatter steps
+    win. Numerically identical to two ``gru_direction`` calls."""
+    hidden = layer["fwd"]["w_hh"].shape[0]
+    B = x.shape[0]
+    # one [B*T, in] x [in, 6H] MXU matmul projects both directions
+    w_ih2 = jnp.concatenate([layer["fwd"]["w_ih"], layer["bwd"]["w_ih"]], axis=1)
+    b_ih2 = jnp.concatenate([layer["fwd"]["b_ih"], layer["bwd"]["b_ih"]])
+    xp = x @ w_ih2 + b_ih2  # [B,T,6H]
+    xp_f = xp[..., : 3 * hidden]
+    xp_b = jnp.flip(xp[..., 3 * hidden :], axis=1)
+    # [T, 2, B, 3H]: scan axis leads, direction is a batched-matmul dim
+    xs = jnp.stack([xp_f, xp_b], axis=0).transpose(2, 0, 1, 3)
+    w_hh2 = jnp.stack([layer["fwd"]["w_hh"], layer["bwd"]["w_hh"]])  # [2,H,3H]
+    b_hh2 = jnp.stack([layer["fwd"]["b_hh"], layer["bwd"]["b_hh"]])[:, None]
+
+    def cell(h, xp_t):  # h [2,B,H], xp_t [2,B,3H]
+        hp = jnp.einsum("dbh,dhn->dbn", h, w_hh2) + b_hh2
+        r = jax.nn.sigmoid(xp_t[..., :hidden] + hp[..., :hidden])
+        z = jax.nn.sigmoid(
+            xp_t[..., hidden : 2 * hidden] + hp[..., hidden : 2 * hidden]
+        )
+        n = jnp.tanh(xp_t[..., 2 * hidden :] + r * hp[..., 2 * hidden :])
+        h_new = (1.0 - z) * n + z * h
+        return h_new, h_new
+
+    h0 = jnp.zeros((2, B, hidden), xp.dtype)
+    _, ys = lax.scan(cell, h0, xs)  # [T,2,B,H]
+    fwd = ys[:, 0].swapaxes(0, 1)
+    bwd = jnp.flip(ys[:, 1].swapaxes(0, 1), axis=1)
+    return jnp.concatenate([fwd, bwd], axis=-1)
+
+
 def bidir_gru_stack(
     params: Tuple[Dict[str, Any], ...],
     x: jax.Array,
@@ -89,9 +129,7 @@ def bidir_gru_stack(
     """
     num_layers = len(params)
     for i, layer in enumerate(params):
-        fwd = gru_direction(layer["fwd"], x, reverse=False)
-        bwd = gru_direction(layer["bwd"], x, reverse=True)
-        x = jnp.concatenate([fwd, bwd], axis=-1)
+        x = bidir_layer(layer, x)
         if dropout > 0.0 and not deterministic and i < num_layers - 1:
             assert rng is not None
             rng, sub = jax.random.split(rng)

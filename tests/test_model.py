@@ -65,3 +65,24 @@ def test_jit_compiles(model, params, batch):
         rtol=1e-5,
         atol=1e-5,
     )
+
+
+def test_bidir_layer_matches_per_direction(rng):
+    """The single-scan fused bidirectional layer == two gru_direction
+    passes (fwd ++ time-reversed bwd)."""
+    import jax.numpy as jnp
+
+    from roko_tpu.models.gru import RokoGRU, bidir_layer, gru_direction
+
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
+    layer = gru.init(jax.random.PRNGKey(11))[0]
+    x = jnp.asarray(rng.standard_normal((5, 90, 24)), jnp.float32)
+    want = jnp.concatenate(
+        [
+            gru_direction(layer["fwd"], x, reverse=False),
+            gru_direction(layer["bwd"], x, reverse=True),
+        ],
+        axis=-1,
+    )
+    got = bidir_layer(layer, x)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5)
