@@ -25,6 +25,18 @@ from jax import lax
 from roko_tpu.models.layers import dropout as _dropout
 
 
+def _pallas_backend() -> bool:
+    """True when the fused Pallas kernels can lower: the live backend is
+    TPU, or ``ROKO_FORCE_PALLAS=1`` (used by the deviceless AOT-compile
+    tests, where the default backend is CPU but compilation targets a
+    TPU topology)."""
+    import os
+
+    if os.environ.get("ROKO_FORCE_PALLAS") == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def gru_layer_params(
     rng: jax.Array, in_size: int, hidden: int, dtype=jnp.float32
 ) -> Dict[str, jax.Array]:
@@ -174,7 +186,7 @@ class RokoGRU:
         # ignored — interpret-mode Pallas is orders of magnitude slower
         # than the numerically-identical scan, and use_pallas can ride
         # along in checkpointed configs.
-        if self.use_pallas and jax.default_backend() == "tpu":
+        if self.use_pallas and _pallas_backend():
             from roko_tpu.models.pallas_gru import bidir_gru_stack_pallas
 
             return bidir_gru_stack_pallas(

@@ -91,7 +91,7 @@ def _fwd_kernel(t_blk: int, hidden: int, cdt, out_dtype):
             h_scratch[...] = jnp.zeros_like(h_scratch)
 
         whh = whh_ref[0]  # [H, 3H]
-        bhh = bhh_ref[...].astype(jnp.float32)  # [1, 3H], broadcasts
+        bhh = bhh_ref[0].astype(jnp.float32)  # [1, 3H], broadcasts
 
         def step(j, h):
             xp = xp_ref[j].astype(jnp.float32)  # [b_blk, 3H]
@@ -134,7 +134,7 @@ def _bwd_kernel(t_blk: int, nt: int, hidden: int, cdt, dxp_dtype):
             dbhh_ref[...] = jnp.zeros(dbhh_ref.shape, dbhh_ref.dtype)
 
         whh = whh_ref[0]  # [H, 3H]
-        bhh = bhh_ref[...].astype(jnp.float32)  # [1, 3H], broadcasts
+        bhh = bhh_ref[0].astype(jnp.float32)  # [1, 3H], broadcasts
         first_time_block = k == nt - 1  # time blocks walked in reverse
 
         def step(jj, carry):
@@ -184,11 +184,11 @@ def _bwd_kernel(t_blk: int, nt: int, hidden: int, cdt, dxp_dtype):
 
         dh0 = dh_scratch[...]
         dwhh0 = dwhh_ref[0]
-        dbhh0 = dbhh_ref[...]  # [1, 3H]
+        dbhh0 = dbhh_ref[0]  # [1, 3H]
         dh, dwhh, dbhh = lax.fori_loop(0, t_blk, step, (dh0, dwhh0, dbhh0))
         dh_scratch[...] = dh
         dwhh_ref[0] = dwhh
-        dbhh_ref[...] = dbhh
+        dbhh_ref[0] = dbhh
 
     return kernel
 
@@ -271,7 +271,7 @@ def _gru_multi_fwd(static, w_ih, b_ih, w_hh, b_hh, x):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, hidden, 3 * hidden), lambda s, i, k: (s, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3 * hidden), lambda s, i, k: (s, 0),
+            pl.BlockSpec((1, 1, 3 * hidden), lambda s, i, k: (s, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((t_blk, b_blk, hidden),
@@ -279,7 +279,7 @@ def _gru_multi_fwd(static, w_ih, b_ih, w_hh, b_hh, x):
                                memory_space=pltpu.VMEM),
         scratch_shapes=[pltpu.VMEM((b_blk, hidden), jnp.float32)],
         interpret=interpret,
-    )(xs, w_hh.astype(cdt), b_hh)
+    )(xs, w_hh.astype(cdt), b_hh.reshape(S, 1, 3 * hidden))
 
     per_dir = _unstack_dirs(hs, flags, B, Bp)
     ys = jnp.stack(per_dir, axis=0)  # [S,B,T,H]
@@ -321,7 +321,7 @@ def _gru_multi_bwd(static, res, dys):
         out_shape=(
             jax.ShapeDtypeStruct((T, S * Bp, 3 * hidden), cdt),
             jax.ShapeDtypeStruct((S, hidden, 3 * hidden), jnp.float32),
-            jax.ShapeDtypeStruct((S, 3 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((S, 1, 3 * hidden), jnp.float32),
         ),
         in_specs=[
             pl.BlockSpec((t_blk, b_blk, 3 * hidden), tmap,
@@ -334,7 +334,7 @@ def _gru_multi_bwd(static, res, dys):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, hidden, 3 * hidden), lambda s, i, k: (s, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3 * hidden), lambda s, i, k: (s, 0),
+            pl.BlockSpec((1, 1, 3 * hidden), lambda s, i, k: (s, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=(
@@ -342,13 +342,14 @@ def _gru_multi_bwd(static, res, dys):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, hidden, 3 * hidden), lambda s, i, k: (s, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 3 * hidden), lambda s, i, k: (s, 0),
+            pl.BlockSpec((1, 1, 3 * hidden), lambda s, i, k: (s, 0, 0),
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[pltpu.VMEM((b_blk, hidden), jnp.float32)],
         interpret=interpret,
-    )(xs, hs, hs_bound, dy, w_hh.astype(cdt), b_hh)
+    )(xs, hs, hs_bound, dy, w_hh.astype(cdt), b_hh.reshape(S, 1, 3 * hidden))
 
+    dbhh = dbhh.reshape(S, 3 * hidden)
     dxp_dirs = _unstack_dirs(dxp, flags, B, Bp)  # S x [B,T,3H]
     dxp_all = jnp.stack(dxp_dirs, axis=0).astype(jnp.float32)  # [S,B,T,3H]
     x32 = x.astype(jnp.float32)

@@ -1,0 +1,40 @@
+"""Driver-contract test for the benchmark entry: one JSON object with
+{"metric", "value", "unit", "vs_baseline"} plus an honest detail block
+(the driver records this line as BENCH_r{N}.json every round)."""
+
+import json
+
+from roko_tpu import benchmark as B
+from roko_tpu.config import ModelConfig
+
+
+def test_bench_json_contract(capsys):
+    B.main(["--batch", "8"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["metric"] == "polished_bases_per_sec_per_chip"
+    assert result["unit"] == "bases/s"
+    assert result["value"] > 0 and result["vs_baseline"] > 0
+    detail = result["detail"]
+    assert detail["batch"] == 8
+    assert detail["scan_windows_per_sec"] > 0
+    assert detail["windows_per_sec"] >= detail["scan_windows_per_sec"]
+    assert detail["model_flops_per_window"] > 0
+    assert detail["torch_cpu_ref_windows_per_sec"] > 0
+    # CPU run: no silent fake-pallas row, no train block by default
+    assert "pallas_windows_per_sec" not in detail
+    assert "train" not in detail
+
+
+def test_model_flops_follow_window_geometry():
+    base = B.model_flops_per_window(ModelConfig())
+    small = B.model_flops_per_window(ModelConfig(window_rows=100, window_cols=45))
+    assert small < base
+    train = B.model_flops_per_window(ModelConfig(), training=True)
+    assert train > base  # fwd+bwd counted
+
+
+def test_train_suite_budget_reports_skips():
+    out = B.run_train_suite(batch=2, budget_s=0.0)
+    skipped = [v for v in out.values() if isinstance(v, dict) and "error" in v]
+    assert skipped and any("budget" in v["error"] for v in skipped)

@@ -1,0 +1,86 @@
+"""AOT-compile the TPU-only code paths with the real v5e compiler.
+
+``libtpu`` is importable even without TPU hardware, so
+``jax.experimental.topologies`` can build a v5e topology and
+``jax.jit(...).lower(...).compile()`` runs the full Mosaic + XLA:TPU
+pipeline deviceless. Interpret-mode Pallas tests check *numerics*; these
+check *lowering* — Mosaic block-shape/tiling constraints (e.g. the
+(8, 128) divisibility rule this suite already caught once) only surface
+here or on hardware."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from roko_tpu.config import ModelConfig
+
+
+@pytest.fixture(scope="module")
+def v5e_sharding():
+    try:
+        from jax.experimental import topologies
+
+        topo = topologies.get_topology_desc(
+            platform="tpu", topology_name="v5e:2x2"
+        )
+    except Exception as e:  # no local libtpu: skip, don't fail
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    mesh = Mesh(np.array(topo.devices[:1]).reshape(1), ("dp",))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def _abstract(tree, dtype, sharding):
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), dtype, sharding=sharding),
+        tree,
+    )
+
+
+def test_pallas_gru_fwd_and_bwd_compile_for_v5e(v5e_sharding):
+    import roko_tpu.models.pallas_gru as pg
+    from roko_tpu.models.gru import RokoGRU
+
+    gru = RokoGRU(in_size=500, hidden=128, num_layers=3, dropout=0.0)
+    params = _abstract(
+        gru.init(jax.random.PRNGKey(0)), jnp.bfloat16, v5e_sharding
+    )
+    x = jax.ShapeDtypeStruct((512, 90, 500), jnp.bfloat16, sharding=v5e_sharding)
+    ct = jax.ShapeDtypeStruct((512, 90, 256), jnp.float32, sharding=v5e_sharding)
+
+    def fwd(p, x):
+        return pg.bidir_gru_stack_pallas(p, x, compute_dtype=jnp.bfloat16)
+
+    jax.jit(fwd).lower(params, x).compile()
+
+    def loss(p, x, ct):
+        return jnp.sum(fwd(p, x) * ct)
+
+    jax.jit(jax.grad(loss)).lower(params, x, ct).compile()
+
+
+def test_flagship_inference_step_compiles_for_v5e(v5e_sharding):
+    """The exact shape bench.py/infer.py run on the chip: bf16 one-hot
+    fast path + fused Pallas recurrence + argmax, batch 512."""
+    from roko_tpu.models.model import RokoModel
+
+    model = RokoModel(ModelConfig(compute_dtype="bfloat16", use_pallas=True))
+    params = _abstract(
+        model.init(jax.random.PRNGKey(0)), jnp.float32, v5e_sharding
+    )
+    x = jax.ShapeDtypeStruct((512, 200, 90), jnp.uint8, sharding=v5e_sharding)
+
+    def predict(p, x):
+        return jnp.argmax(model.apply(p, x, deterministic=True), axis=-1)
+
+    # use_pallas routing checks the live backend (CPU here); force the
+    # pallas path for the deviceless TPU-target compile
+    monkeypatch = pytest.MonkeyPatch()
+    monkeypatch.setenv("ROKO_FORCE_PALLAS", "1")
+    try:
+        jax.jit(predict).lower(params, x).compile()
+    finally:
+        monkeypatch.undo()
