@@ -18,24 +18,32 @@ from roko_tpu.config import ModelConfig
 
 
 @pytest.fixture(scope="module")
-def v5e_sharding():
+def v5e_topo():
     try:
         from jax.experimental import topologies
 
-        topo = topologies.get_topology_desc(
+        return topologies.get_topology_desc(
             platform="tpu", topology_name="v5e:2x2"
         )
     except Exception as e:  # no local libtpu: skip, don't fail
         pytest.skip(f"TPU AOT topology unavailable: {e}")
+
+
+@pytest.fixture(scope="module")
+def v5e_sharding(v5e_topo):
     from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-    mesh = Mesh(np.array(topo.devices[:1]).reshape(1), ("dp",))
+    mesh = Mesh(np.array(v5e_topo.devices[:1]).reshape(1), ("dp",))
     return NamedSharding(mesh, PartitionSpec())
 
 
 def _abstract(tree, dtype, sharding):
+    """Abstract a pytree for AOT lowering; dtype=None keeps each leaf's
+    own dtype (opt states mix int32 counts with float moments)."""
     return jax.tree.map(
-        lambda a: jax.ShapeDtypeStruct(np.shape(a), dtype, sharding=sharding),
+        lambda a: jax.ShapeDtypeStruct(
+            np.shape(a), dtype or np.asarray(a).dtype, sharding=sharding
+        ),
         tree,
     )
 
@@ -84,3 +92,40 @@ def test_flagship_inference_step_compiles_for_v5e(v5e_sharding):
         jax.jit(predict).lower(params, x).compile()
     finally:
         monkeypatch.undo()
+
+
+def test_dp_sharded_train_step_compiles_for_v5e_mesh(v5e_topo):
+    """The full jitted train step (fwd+bwd+Adam, batch dp-sharded,
+    psum-all-reduced grads) compiled for a REAL 4-chip v5e topology —
+    stronger evidence than the CPU-mesh dryrun that the multi-chip path
+    lowers for hardware, including the ICI all-reduce."""
+    import optax
+    from jax.sharding import Mesh
+
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.parallel.mesh import (
+        AXIS_DP, AXIS_SP, AXIS_TP, data_sharding, replicated_sharding,
+    )
+    from roko_tpu.training.loop import make_train_step
+
+    mesh = Mesh(
+        np.array(v5e_topo.devices).reshape(4, 1, 1), (AXIS_DP, AXIS_TP, AXIS_SP)
+    )
+    model = RokoModel(ModelConfig(compute_dtype="bfloat16"))
+    tx = optax.adam(1e-4)
+    cpu_params = model.init(jax.random.PRNGKey(0))
+    repl = replicated_sharding(mesh)
+    data = data_sharding(mesh)
+    params = _abstract(cpu_params, jnp.float32, repl)
+    # dtype=None preserves Adam's int32 count — the compile must cover
+    # the exact program production runs
+    opt_state = _abstract(tx.init(cpu_params), None, repl)
+    step = make_train_step(model, tx, mesh)
+
+    B = 512
+    x = jax.ShapeDtypeStruct((B, 200, 90), jnp.uint8, sharding=data)
+    y = jax.ShapeDtypeStruct((B, 90), jnp.int32, sharding=data)
+    w = jax.ShapeDtypeStruct((B,), jnp.float32, sharding=data)
+    step_no = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
+    step.lower(params, opt_state, step_no, x, y, w, rng).compile()
