@@ -129,3 +129,71 @@ def test_dp_sharded_train_step_compiles_for_v5e_mesh(v5e_topo):
     step_no = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
     rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
     step.lower(params, opt_state, step_no, x, y, w, rng).compile()
+
+
+def test_transformer_tp_and_ring_sp_compile_for_v5e_mesh(v5e_topo):
+    """The other two multi-chip configs the CPU dryrun exercises,
+    compiled for real v5e hardware: dp x tp with Megatron-sharded
+    transformer params, and dp x sp with ring attention (shard_map +
+    ppermute over the sequence axis)."""
+    import optax
+    from jax.sharding import Mesh
+
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.parallel.mesh import (
+        AXIS_DP, AXIS_SP, AXIS_TP, data_sharding, replicated_sharding,
+    )
+    from roko_tpu.parallel.ring import make_ring_attention
+    from roko_tpu.parallel.tp import param_sharding
+    from roko_tpu.training.loop import make_train_step
+
+    cfg = ModelConfig(kind="transformer", num_layers=2, compute_dtype="bfloat16")
+    tx = optax.adam(1e-4)
+    B = 64
+
+    def compile_step(mesh, model, make_pshard=None):
+        repl = replicated_sharding(mesh)
+        data = data_sharding(mesh)
+        cpu_params = model.init(jax.random.PRNGKey(0))
+        opt0 = tx.init(cpu_params)
+        if make_pshard is None:
+            params = _abstract(cpu_params, None, repl)
+            opt_state = _abstract(opt0, None, repl)
+        else:
+            pshard = make_pshard(cpu_params)
+            params = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(np.shape(a), a.dtype, sharding=s),
+                cpu_params, pshard,
+            )
+            oshard = optax.tree_map_params(
+                tx, lambda _, s: s, opt0, pshard,
+                transform_non_params=lambda _: repl,
+            )
+            opt_state = jax.tree.map(
+                lambda a, s: jax.ShapeDtypeStruct(np.shape(a), a.dtype, sharding=s),
+                opt0, oshard,
+            )
+        step = make_train_step(model, tx, mesh)
+        x = jax.ShapeDtypeStruct((B, 200, 90), jnp.uint8, sharding=data)
+        y = jax.ShapeDtypeStruct((B, 90), jnp.int32, sharding=data)
+        w = jax.ShapeDtypeStruct((B,), jnp.float32, sharding=data)
+        step_no = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
+        rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
+        step.lower(params, opt_state, step_no, x, y, w, rng).compile()
+
+    # dp=2 x tp=2: Megatron column/row-sharded attention + MLP matmuls
+    mesh = Mesh(
+        np.array(v5e_topo.devices).reshape(2, 2, 1), (AXIS_DP, AXIS_TP, AXIS_SP)
+    )
+    compile_step(
+        Mesh(np.array(v5e_topo.devices).reshape(2, 2, 1),
+             (AXIS_DP, AXIS_TP, AXIS_SP)),
+        RokoModel(cfg),
+        make_pshard=lambda p: param_sharding(cfg, p, mesh),
+    )
+
+    # dp=2 x sp=2: ring attention rotates K/V via ppermute over ICI
+    mesh = Mesh(
+        np.array(v5e_topo.devices).reshape(2, 1, 2), (AXIS_DP, AXIS_TP, AXIS_SP)
+    )
+    compile_step(mesh, RokoModel(cfg, attn_fn=make_ring_attention(mesh, cfg.num_heads)))
