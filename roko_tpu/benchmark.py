@@ -58,9 +58,10 @@ def _device_peak_flops() -> Optional[float]:
 
 
 def model_flops_per_window(cfg, *, training: bool = False) -> float:
-    """Analytic matmul FLOPs per window for the GRU consensus model
-    (inference uses the one-hot reassociated embed+fc1 fast path,
-    models/model.py:119-132; training uses the direct fc1 chain).
+    """Analytic matmul FLOPs per window for the GRU consensus model.
+    Inference uses the one-hot reassociated embed+fc1 fast path; training
+    materialises the embedding via a one-hot GEMM (dropout sits between
+    embed and fc1) then contracts the read axis (models/model.py apply).
     Backward pass counted as 2x forward for training."""
     T, R, V = cfg.window_cols, cfg.window_rows, cfg.embed_vocab
     D = cfg.embed_dim
@@ -69,7 +70,8 @@ def model_flops_per_window(cfg, *, training: bool = False) -> float:
     gin = cfg.gru_in_size
 
     if training:
-        embed_fc1 = 2 * T * D * J1 * R  # [*,R] @ [R,J1] after gather
+        # onehot[B,R,T,V] @ E[V,D], then e[B,R,T,D] x W1[R,J1]
+        embed_fc1 = 2 * T * R * V * D + 2 * T * D * J1 * R
     else:
         # einsum brtv,rj + vd,btvj
         embed_fc1 = 2 * T * V * J1 * R + 2 * T * D * J1 * V

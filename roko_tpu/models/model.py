@@ -105,13 +105,26 @@ class RokoModel:
             assert rng is not None, "training forward needs a dropout rng"
             rngs = list(jax.random.split(rng, 4))
 
+        # Both paths avoid the embedding gather: with a 12-word vocab a
+        # one-hot matmul has exactly one nonzero term scaled by 1.0 per
+        # output element, so it is BIT-identical to jnp.take — and both
+        # its forward and its backward (the train-step hot spot: a
+        # 9.2M-row scatter-add) become MXU GEMMs.
+        onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
+        w1 = params["fc1"]["kernel"].astype(dtype)  # [200, J]
         if train:
-            e = jnp.take(params["embedding"], x, axis=0)  # [B,200,90,50]
-            e = e.astype(dtype)
+            # The per-element dropout between embed and fc1 (reference
+            # placement, roko/rnn_model.py:47-49) forces materialising e,
+            # so the inference-only reassociation below can't be used
+            # here; the read-axis contraction is left to einsum so XLA
+            # picks the layout instead of paying an explicit 920 MB
+            # transpose.
+            e = jnp.einsum(
+                "brtv,vd->brtd", onehot, params["embedding"].astype(dtype)
+            )  # [B,200,90,50]
             e = _dropout(rngs[0], e, cfg.dropout)
-            # read axis (200) to the back: [B,90,50,200]
-            e = e.transpose(0, 2, 3, 1)
-            h = jax.nn.relu(_dense(cast_tree(params["fc1"], dtype), e))
+            h = jnp.einsum("brtd,rj->btdj", e, w1)
+            h = jax.nn.relu(h + params["fc1"]["bias"].astype(dtype))
             h = _dropout(rngs[1], h, cfg.dropout)
         else:
             # Inference fast path: embedding-gather + transpose + fc1 is
@@ -123,8 +136,6 @@ class RokoModel:
             # as the reference chain (roko/rnn_model.py:47-51) up to float
             # summation order; only valid without the per-element dropout
             # between embed and fc1, hence inference-only.
-            onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
-            w1 = params["fc1"]["kernel"].astype(dtype)  # [200, J]
             # contract the read axis first: [B,T,V,J]
             m = jnp.einsum("brtv,rj->btvj", onehot, w1)
             emb = params["embedding"].astype(dtype)  # [V, D]

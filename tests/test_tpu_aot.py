@@ -182,14 +182,13 @@ def test_transformer_tp_and_ring_sp_compile_for_v5e_mesh(v5e_topo):
         step.lower(params, opt_state, step_no, x, y, w, rng).compile()
 
     # dp=2 x tp=2: Megatron column/row-sharded attention + MLP matmuls
-    mesh = Mesh(
+    tp_mesh = Mesh(
         np.array(v5e_topo.devices).reshape(2, 2, 1), (AXIS_DP, AXIS_TP, AXIS_SP)
     )
     compile_step(
-        Mesh(np.array(v5e_topo.devices).reshape(2, 2, 1),
-             (AXIS_DP, AXIS_TP, AXIS_SP)),
+        tp_mesh,
         RokoModel(cfg),
-        make_pshard=lambda p: param_sharding(cfg, p, mesh),
+        make_pshard=lambda p: param_sharding(cfg, p, tp_mesh),
     )
 
     # dp=2 x sp=2: ring attention rotates K/V via ppermute over ICI
