@@ -45,3 +45,39 @@ def test_train_suite_budget_reports_skips():
     out = B.run_train_suite(batch=2, budget_s=0.0)
     skipped = [v for v in out.values() if isinstance(v, dict) and "error" in v]
     assert skipped and any("budget" in v["error"] for v in skipped)
+
+
+def test_inference_suite_sweeps_batches_and_takes_best(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    rates = {512: 100.0, 2048: 250.0}
+    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1: rates[b])
+    detail = B.run_inference_suite()  # default run sweeps on TPU
+    assert set(detail["batch_sweep"]) == {str(b) for b in B.SWEEP_BATCHES}
+    # headline is best-of-sweep; the r2-comparable first batch stays
+    # reported under the legacy keys
+    assert detail["windows_per_sec"] == 250.0
+    assert detail["best_batch"] == 2048
+    assert detail["scan_windows_per_sec"] == 100.0
+    # an explicit batch bypasses the sweep even when it equals BATCH
+    detail = B.run_inference_suite(B.BATCH)
+    assert set(detail["batch_sweep"]) == {str(B.BATCH)}
+
+
+def test_inference_suite_no_sweep_off_tpu(monkeypatch):
+    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1: 10.0)
+    detail = B.run_inference_suite()
+    assert set(detail["batch_sweep"]) == {str(B.BATCH)}
+    assert "pallas_windows_per_sec" not in detail
+
+
+def test_inference_suite_raises_when_all_paths_fail(monkeypatch):
+    def boom(cfg, b, iters=1):
+        raise ValueError("kernel exploded")
+
+    monkeypatch.setattr(B, "bench_infer", boom)
+    import pytest
+
+    with pytest.raises(RuntimeError, match="all inference paths failed"):
+        B.run_inference_suite()
