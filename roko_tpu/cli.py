@@ -187,10 +187,28 @@ def cmd_bench(args: argparse.Namespace) -> int:
     argv: List[str] = []
     if args.train:
         argv.append("--train")
-    argv += ["--batch", str(args.b)]
+    if args.b is not None:  # None = default run (TPU batch sweep)
+        argv += ["--batch", str(args.b)]
     if args.out:
         argv += ["--out", args.out]
     bench_main(argv)
+    return 0
+
+
+def cmd_assess(args: argparse.Namespace) -> int:
+    """Polished-vs-truth accuracy report (the reference obtains these
+    numbers from the external pomoxis assess_assembly,
+    ref README.md:97-112; here it is built in)."""
+    from roko_tpu.eval.assess import assess_fastas, format_report, write_json
+    from roko_tpu.io.fasta import read_fasta
+
+    truth = {n: s.encode() for n, s in read_fasta(args.truth)}
+    polished = {n: s.encode() for n, s in read_fasta(args.polished)}
+    res = assess_fastas(truth, polished, k=args.k)
+    print(format_report(res))
+    if args.json:
+        write_json(res, args.json)
+        print(f"wrote {args.json}")
     return 0
 
 
@@ -272,9 +290,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("bench", help="print the benchmark JSON line")
     p.add_argument("--train", action="store_true", help="also time training steps")
-    p.add_argument("--b", type=int, default=512, help="benchmark batch size")
+    p.add_argument(
+        "--b", type=int, default=None,
+        help="exact benchmark batch size (default: sweep on TPU)",
+    )
     p.add_argument("--out", default=None, help="write full results JSON here")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "assess",
+        help="polished FASTA vs truth FASTA -> error rates + Qscore",
+    )
+    p.add_argument("polished", help="polished assembly FASTA")
+    p.add_argument("truth", help="truth/reference FASTA")
+    p.add_argument("--k", type=int, default=16, help="anchor k-mer size")
+    p.add_argument("--json", default=None, help="also write a JSON report here")
+    p.set_defaults(fn=cmd_assess)
 
     return parser
 

@@ -1,0 +1,20 @@
+"""Assembly assessment: built-in equivalent of the external pomoxis
+``assess_assembly`` step the reference's workflow depends on for its
+published accuracy table (/root/reference/README.md:97-112)."""
+
+from roko_tpu.eval.align import banded_align, AlignResult
+from roko_tpu.eval.assess import (
+    AssessResult,
+    ContigAssessment,
+    assess_fastas,
+    assess_pair,
+)
+
+__all__ = [
+    "AlignResult",
+    "AssessResult",
+    "ContigAssessment",
+    "assess_fastas",
+    "assess_pair",
+    "banded_align",
+]

@@ -66,6 +66,16 @@ def _load_locked() -> ctypes.CDLL:
         ctypes.POINTER(_RokoResult),
     ]
     lib.roko_free_result.argtypes = [ctypes.POINTER(_RokoResult)]
+    lib.roko_align_counts.restype = ctypes.c_int
+    lib.roko_align_counts.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
     if lib.roko_native_abi_version() != 1:
         raise RuntimeError("native extractor ABI mismatch; rebuild")
     _lib = lib
@@ -128,6 +138,22 @@ def extract_windows_arrays(
     finally:
         lib.roko_free_result(ctypes.byref(res))
     return pos, mat
+
+
+def align_counts(a: bytes, b: bytes, pad: int, max_cells: int):
+    """Banded global alignment op counts for the assess tool's segment
+    hot loop: returns (match, sub, ins, del, hit_band_edge). Raises
+    MemoryError when band x length exceeds ``max_cells`` (the caller
+    widens the band in steps, so this bounds the retry cost)."""
+    lib = _load()
+    out = (ctypes.c_int64 * 8)()
+    rc = lib.roko_align_counts(a, len(a), b, len(b), pad, max_cells, out)
+    if rc == 3:
+        raise MemoryError("alignment working set exceeds max_cells")
+    if rc != 0:
+        msg = lib.roko_last_error().decode(errors="replace")
+        raise RuntimeError(f"native aligner failed ({rc}): {msg}")
+    return out[0], out[1], out[2], out[3], bool(out[4])
 
 
 def extract_windows(

@@ -17,8 +17,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(HERE, "src")
 OUT = os.path.join(HERE, "_roko_native.so")
 
-SOURCES = ["bgzf.cc", "bam.cc", "extract.cc", "capi.cc"]
-HEADERS = ["bgzf.h", "bam.h", "extract.h"]
+SOURCES = ["bgzf.cc", "bam.cc", "extract.cc", "align.cc", "capi.cc"]
+HEADERS = ["bgzf.h", "bam.h", "extract.h", "align.h"]
 
 
 def build(verbose: bool = True) -> str:

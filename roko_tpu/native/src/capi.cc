@@ -5,6 +5,7 @@
 #include <cstring>
 #include <string>
 
+#include "align.h"
 #include "extract.h"
 
 namespace {
@@ -61,6 +62,31 @@ int roko_extract_windows(const char* bam_path, const char* contig,
                   res.positions.size() * sizeof(int64_t));
       std::memcpy(out->matrix, res.matrix.data(), res.matrix.size());
     }
+    return 0;
+  } catch (const std::exception& e) {
+    g_last_error = e.what();
+    return 1;
+  }
+}
+
+// Banded global alignment of a vs b (roko_tpu/eval/assess.py segment
+// hot loop). out8 receives [match, sub, ins, del, hit_band_edge, 0, 0,
+// 0]. Returns 0 on success, 3 when the band x length working set
+// exceeds max_cells (caller shrinks the segment or widens in steps).
+int roko_align_counts(const char* a, int64_t la, const char* b, int64_t lb,
+                      int64_t pad, int64_t max_cells, int64_t* out8) {
+  try {
+    roko::AlignCounts c;
+    if (!roko::BandedAlign(a, la, b, lb, pad, max_cells, &c)) {
+      g_last_error = "alignment working set exceeds max_cells";
+      return 3;
+    }
+    out8[0] = c.match;
+    out8[1] = c.sub;
+    out8[2] = c.ins;
+    out8[3] = c.del_;
+    out8[4] = c.hit_band_edge ? 1 : 0;
+    out8[5] = out8[6] = out8[7] = 0;
     return 0;
   } catch (const std::exception& e) {
     g_last_error = e.what();

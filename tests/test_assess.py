@@ -1,0 +1,200 @@
+"""Assembly-assessment tests: banded aligner semantics (Python oracle
+vs native C++ bit-equality), planted-mutation recovery through the
+anchor pipeline, contig pairing (names, k-mer content, reverse
+complement), and the CLI report."""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from roko_tpu.eval.align import AlignResult, banded_align_py
+from roko_tpu.eval.assess import (
+    assess_fastas,
+    assess_pair,
+    format_report,
+    revcomp,
+)
+from roko_tpu.native import binding
+
+BASES = "ACGT"
+
+
+def rand_seq(rng: random.Random, n: int) -> bytes:
+    return "".join(rng.choice(BASES) for _ in range(n)).encode()
+
+
+def mutate(rng: random.Random, seq: bytes, n_sub: int, n_ins: int, n_del: int,
+           spacing: int = 40):
+    """Plant spaced, unambiguous mutations; returns (mutated, counts).
+    Substitutions change the base; ins/del are single bases. Spacing
+    keeps edits isolated so the minimal alignment is unique."""
+    sites = rng.sample(
+        range(spacing, len(seq) - spacing, spacing), n_sub + n_ins + n_del
+    )
+    rng.shuffle(sites)
+    edits = (
+        [("sub", p) for p in sites[:n_sub]]
+        + [("ins", p) for p in sites[n_sub : n_sub + n_ins]]
+        + [("del", p) for p in sites[n_sub + n_ins :]]
+    )
+    edits.sort(key=lambda e: e[1], reverse=True)
+    out = bytearray(seq)
+    for kind, p in edits:
+        if kind == "sub":
+            old = chr(out[p])
+            out[p] = ord(rng.choice([b for b in BASES if b != old]))
+        elif kind == "ins":
+            out[p:p] = rng.choice(BASES).encode()
+        else:
+            del out[p]
+    return bytes(out)
+
+
+# ---------------------------------------------------------------- aligner
+
+
+def test_oracle_basic_ops():
+    assert banded_align_py(b"ACGT", b"ACGT", 4) == AlignResult(4, 0, 0, 0, False)
+    assert banded_align_py(b"ACGT", b"ACTT", 4).sub == 1
+    r = banded_align_py(b"ACGTACGT", b"ACGACGT", 4)
+    assert (r.match, r.sub, r.ins, r.dele) == (7, 0, 0, 1)
+    r = banded_align_py(b"ACGACGT", b"ACGTACGT", 4)
+    assert (r.match, r.sub, r.ins, r.dele) == (7, 0, 1, 0)
+    assert banded_align_py(b"", b"ACG", 4) == AlignResult(0, 0, 3, 0, False)
+    assert banded_align_py(b"ACG", b"", 4) == AlignResult(0, 0, 0, 3, False)
+
+
+def test_oracle_band_edge_flag():
+    # mid-sequence 4-base deletion with zero padding: after the gap the
+    # optimal path runs along the band's lower edge -> flagged
+    a = b"ACGTACGTAC" + b"GGGG" + b"TTCCAGTACG"
+    b = b"ACGTACGTAC" + b"TTCCAGTACG"
+    r = banded_align_py(a, b, 0)
+    assert r.dele == 4 and r.hit_band_edge
+    # generous padding: same ops, no edge contact
+    r = banded_align_py(a, b, 8)
+    assert r.dele == 4 and not r.hit_band_edge
+
+
+@pytest.mark.skipif(not binding.is_available(), reason="native lib unavailable")
+def test_native_matches_oracle_bitwise():
+    rng = random.Random(11)
+    for trial in range(25):
+        a = rand_seq(rng, rng.randrange(1, 400))
+        b = mutate(
+            rng, a, rng.randrange(0, 3), rng.randrange(0, 3),
+            rng.randrange(0, 3), spacing=30,
+        ) if len(a) > 240 else rand_seq(rng, rng.randrange(1, 400))
+        pad = rng.choice([4, 16, 64])
+        want = banded_align_py(a, b, pad)
+        got = binding.align_counts(a, b, pad, 10**8)
+        assert got == (want.match, want.sub, want.ins, want.dele,
+                       want.hit_band_edge), (trial, a, b, pad)
+
+
+@pytest.mark.skipif(not binding.is_available(), reason="native lib unavailable")
+def test_native_max_cells_raises():
+    with pytest.raises(MemoryError):
+        binding.align_counts(b"A" * 1000, b"A" * 1000, 500, 1000)
+
+
+# ---------------------------------------------------------------- assess
+
+
+def test_assess_recovers_planted_mutations():
+    rng = random.Random(7)
+    truth = rand_seq(rng, 20_000)
+    polished = mutate(rng, truth, n_sub=12, n_ins=5, n_del=8)
+    c = assess_pair(truth, polished)
+    assert (c.sub, c.ins, c.dele) == (12, 5, 8)
+    assert c.match + c.dele + c.sub == len(truth)
+    assert abs(c.qscore - (-10 * math.log10(25 / len(truth)))) < 1e-9
+
+
+def test_assess_soft_masked_truth_is_not_an_error():
+    # lowercase (soft-masked) regions are sequence, not differences
+    rng = random.Random(31)
+    truth = bytearray(rand_seq(rng, 5_000))
+    truth[2000:2600] = bytes(truth[2000:2600]).lower()
+    c = assess_pair(bytes(truth), bytes(truth).upper())
+    assert c.errors == 0 and math.isinf(c.qscore)
+
+
+def test_assess_perfect_match_is_infinite_q():
+    rng = random.Random(3)
+    truth = rand_seq(rng, 5_000)
+    c = assess_pair(truth, truth)
+    assert c.errors == 0 and math.isinf(c.qscore)
+    assert c.match == len(truth)
+
+
+def test_assess_reverse_complement_contig():
+    rng = random.Random(5)
+    truth = rand_seq(rng, 10_000)
+    polished = revcomp(mutate(rng, truth, n_sub=6, n_ins=0, n_del=0))
+    c = assess_pair(truth, polished)
+    assert c.reverse_complemented
+    assert c.sub == 6 and c.ins == 0 and c.dele == 0
+
+
+def test_assess_fastas_pairs_by_content_when_names_differ():
+    rng = random.Random(9)
+    t1, t2 = rand_seq(rng, 8_000), rand_seq(rng, 6_000)
+    res = assess_fastas(
+        {"chrA": t1, "chrB": t2},
+        {"contig_2": mutate(rng, t2, 3, 1, 1), "contig_1": mutate(rng, t1, 2, 2, 2)},
+    )
+    by_truth = {c.truth_name: c for c in res.contigs}
+    assert by_truth["chrA"].polished_name == "contig_1"
+    assert by_truth["chrB"].polished_name == "contig_2"
+    assert by_truth["chrA"].errors == 6
+    assert by_truth["chrB"].errors == 5
+    # summary aggregates per truth base
+    s = res.summary()
+    assert s["truth_len"] == 14_000
+    assert s["total_error_pct"] == pytest.approx(100 * 11 / 14_000, abs=1e-4)
+
+
+def test_assess_unpaired_truth_counts_as_deleted():
+    rng = random.Random(13)
+    t1, t2 = rand_seq(rng, 4_000), rand_seq(rng, 3_000)
+    res = assess_fastas({"a": t1, "b": t2}, {"a_polished": mutate(rng, t1, 1, 0, 0)})
+    by_truth = {c.truth_name: c for c in res.contigs}
+    assert by_truth["b"].polished_name is None
+    assert by_truth["b"].dele == 3_000
+    assert "b" in res.summary()["unpaired_truth_contigs"]
+
+
+def test_report_formats(tmp_path):
+    rng = random.Random(21)
+    truth = rand_seq(rng, 6_000)
+    res = assess_fastas({"ctg": truth}, {"ctg": mutate(rng, truth, 4, 2, 3)})
+    text = format_report(res)
+    assert "ctg" in text and "TOTAL" in text
+    from roko_tpu.eval.assess import write_json
+    import json
+
+    out = tmp_path / "report.json"
+    write_json(res, str(out))
+    doc = json.loads(out.read_text())
+    assert doc["summary"]["contigs"] == 1
+    assert doc["contigs"][0]["mismatch"] == 4
+
+
+def test_cli_assess(tmp_path, capsys):
+    from roko_tpu.cli import main
+    from roko_tpu.io.fasta import write_fasta
+
+    rng = random.Random(17)
+    truth = rand_seq(rng, 5_000).decode()
+    polished = mutate(rng, truth.encode(), 2, 1, 1).decode()
+    tf, pf = tmp_path / "truth.fasta", tmp_path / "polished.fasta"
+    write_fasta(str(tf), [("ctg", truth)])
+    write_fasta(str(pf), [("ctg", polished)])
+    jf = tmp_path / "r.json"
+    rc = main(["assess", str(pf), str(tf), "--json", str(jf)])
+    assert rc == 0
+    outp = capsys.readouterr().out
+    assert "TOTAL" in outp and jf.exists()
