@@ -318,6 +318,95 @@ def run_train_suite(
     return out
 
 
+def run_features_suite(
+    draft_len: int = 200_000, coverage: int = 30
+) -> Dict[str, Any]:
+    """Host-side feature-extraction throughput (the CPU stage that feeds
+    the chip): synthesises a draft + ~coverage x gapless 1%-substitution
+    reads through the package's own BAM writer, then times
+    ``run_features`` with the native (C++) and pure-Python extractor
+    backends. Reported in windows/s and draft-bases/s — CPU numbers,
+    meaningful on any host."""
+    import random
+    import tempfile
+    import os
+
+    from roko_tpu import constants as C
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.io.bam import BamRecord, write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+
+    rng = random.Random(0)
+    bases = "ACGT"
+    draft = "".join(rng.choice(bases) for _ in range(draft_len))
+    read_len = 3000
+    records = []
+    n_reads = draft_len * coverage // read_len
+    for i in range(n_reads):
+        start = rng.randrange(0, draft_len - read_len)
+        seq = list(draft[start : start + read_len])
+        for j in range(len(seq)):  # ~1% substitutions
+            if rng.random() < 0.01:
+                seq[j] = rng.choice([b for b in bases if b != seq[j]])
+        records.append(
+            BamRecord(
+                name=f"r{i}", flag=0, tid=0, pos=start, mapq=60,
+                cigar=((C.CIGAR_M, read_len),), seq="".join(seq),
+                qual=b"I" * read_len,
+            )
+        )
+    out: Dict[str, Any] = {
+        "draft_len": draft_len, "coverage": coverage, "workers": 1,
+    }
+    # build the native .so (if stale/missing) BEFORE the timed window, so
+    # a clean host doesn't count the g++ compile as extraction time
+    try:
+        from roko_tpu.native import binding as _binding
+
+        _binding.is_available()
+    except Exception:
+        pass
+    with tempfile.TemporaryDirectory() as td:
+        fasta = os.path.join(td, "draft.fasta")
+        bam = os.path.join(td, "reads.bam")
+        write_fasta(fasta, [("ctg", draft)])
+        write_sorted_bam(bam, [("ctg", draft_len)], records)
+        for backend in ("native", "python"):
+            # the native pass must override, not merely not-set, the
+            # force-python debug knob a user may have exported
+            env = {
+                "ROKO_TPU_FORCE_PY_EXTRACTOR": (
+                    "0" if backend == "native" else "1"
+                )
+            }
+            old = {k: os.environ.get(k) for k in env}
+            os.environ.update(env)
+            try:
+                t0 = time.perf_counter()
+                n = run_features(
+                    fasta,
+                    bam,
+                    os.path.join(td, f"{backend}.hdf5"),
+                    seed=0,
+                    log=lambda *a, **k: None,
+                )
+                dt = time.perf_counter() - t0
+                out[backend] = {
+                    "windows_per_sec": round(n / dt, 1),
+                    "draft_bases_per_sec": round(draft_len / dt, 1),
+                    "seconds": round(dt, 2),
+                }
+            except Exception as e:
+                out[backend] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            finally:
+                for k, v in old.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+    return out
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -327,6 +416,11 @@ def main(argv=None) -> None:
 
     ap = argparse.ArgumentParser(prog="roko-tpu bench")
     ap.add_argument("--train", action="store_true", help="also time training steps")
+    ap.add_argument(
+        "--features",
+        action="store_true",
+        help="also time host-side feature extraction (native vs Python)",
+    )
     ap.add_argument(
         "--batch",
         type=int,
@@ -357,6 +451,8 @@ def main(argv=None) -> None:
         detail["train"] = run_train_suite(
             args.batch or BATCH, budget_s=train_budget
         )
+    if args.features:
+        detail["features"] = run_features_suite()
     ref_windows_per_sec = bench_torch_reference()
     detail["torch_cpu_ref_windows_per_sec"] = round(ref_windows_per_sec, 1)
     windows_per_sec = detail["windows_per_sec"]

@@ -187,6 +187,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
     argv: List[str] = []
     if args.train:
         argv.append("--train")
+    if args.features:
+        argv.append("--features")
     if args.b is not None:  # None = default run (TPU batch sweep)
         argv += ["--batch", str(args.b)]
     if args.out:
@@ -290,6 +292,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("bench", help="print the benchmark JSON line")
     p.add_argument("--train", action="store_true", help="also time training steps")
+    p.add_argument(
+        "--features",
+        action="store_true",
+        help="also time host-side feature extraction (native vs Python)",
+    )
     p.add_argument(
         "--b", type=int, default=None,
         help="exact benchmark batch size (default: sweep on TPU)",

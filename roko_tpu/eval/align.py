@@ -141,6 +141,7 @@ def align_with_band_growth(
     band might find a cheaper path). Returns the last result — with
     ``hit_band_edge`` still set — when ``max_pad`` or the cell budget
     caps growth, so callers can count capped segments honestly."""
+    pad = max(1, pad)  # pad=0 would double to 0 forever on edge contact
     while True:
         try:
             res = banded_align(a, b, pad, max_cells)

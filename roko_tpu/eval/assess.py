@@ -49,6 +49,8 @@ def revcomp(seq: bytes) -> bytes:
 def _kmer_codes(seq: bytes, k: int = K) -> Tuple[np.ndarray, np.ndarray]:
     """(codes, positions) of all ACGT-only k-mers, 2-bit rolling encode.
     Positions with any non-ACGT base are dropped (N's break anchors)."""
+    if not 1 <= k <= 32:
+        raise ValueError(f"k must be in [1, 32] (2 bits/base in int64), got {k}")
     arr = np.frombuffer(seq.upper(), dtype=np.uint8)
     n = arr.size
     if n < k:

@@ -66,6 +66,18 @@ def test_oracle_basic_ops():
     assert banded_align_py(b"ACG", b"", 4) == AlignResult(0, 0, 0, 3, False)
 
 
+def test_band_growth_pad_zero_terminates():
+    from roko_tpu.eval.align import align_with_band_growth
+
+    r = align_with_band_growth(b"ACGT", b"ACGT", pad=0)
+    assert r.match == 4 and r.errors == 0
+
+
+def test_k_out_of_range_raises():
+    with pytest.raises(ValueError, match=r"\[1, 32\]"):
+        assess_pair(b"ACGT" * 100, b"ACGT" * 100, k=40)
+
+
 def test_oracle_band_edge_flag():
     # mid-sequence 4-base deletion with zero padding: after the gap the
     # optimal path runs along the band's lower edge -> flagged

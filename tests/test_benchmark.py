@@ -72,6 +72,15 @@ def test_inference_suite_no_sweep_off_tpu(monkeypatch):
     assert "pallas_windows_per_sec" not in detail
 
 
+def test_features_suite_times_both_backends():
+    out = B.run_features_suite(draft_len=20_000, coverage=8)
+    for backend in ("native", "python"):
+        r = out[backend]
+        assert ("windows_per_sec" in r and r["windows_per_sec"] > 0) or "error" in r
+    # this image always has the toolchain, so native must really run
+    assert "windows_per_sec" in out["native"]
+
+
 def test_inference_suite_raises_when_all_paths_fail(monkeypatch):
     def boom(cfg, b, iters=1):
         raise ValueError("kernel exploded")
