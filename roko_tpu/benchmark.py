@@ -277,13 +277,18 @@ def run_train_suite(
     t0 = time.perf_counter()
     peak = _device_peak_flops()
     out: Dict[str, Any] = {"batch": batch}
-    # Order = value under a tight budget: the three BASELINE.md rows
-    # (flagship GRU, scan-depth stress, transformer variant) first, the
-    # bonus fused-Pallas row last (r3 on-chip measurement: each suite
-    # costs ~60-90s of fresh compile, and a 360s budget fits about
-    # three of four).
+    # Order = information value under a tight budget (each suite costs
+    # ~60-90s of fresh compile; the default 480s budget fits about
+    # four): flagship GRU, then its remat A/B (the driver-measured
+    # evidence for flipping ModelConfig.remat_frontend — BASELINE.md
+    # "training backward anomaly"), then the two remaining BASELINE.md
+    # rows; the fused-Pallas row last because r3 already measured it
+    # within noise of the scan path (177.6 vs 173.1 ms).
     suites = {
         "train_gru": ModelConfig(compute_dtype="bfloat16"),
+        "train_gru_remat": ModelConfig(
+            compute_dtype="bfloat16", remat_frontend=True
+        ),
         "train_scan_stress": ModelConfig(
             compute_dtype="bfloat16", num_layers=4, hidden_size=256
         ),
@@ -435,9 +440,9 @@ def main(argv=None) -> None:
     # parse the env knob BEFORE any measurement so a typo can't discard
     # minutes of completed TPU work on a late ValueError
     try:
-        train_budget = float(os.environ.get("ROKO_BENCH_TRAIN_BUDGET", "360"))
+        train_budget = float(os.environ.get("ROKO_BENCH_TRAIN_BUDGET", "480"))
     except ValueError:
-        train_budget = 360.0
+        train_budget = 480.0
 
     detail = run_inference_suite(args.batch)
     # the driver's end-of-round run invokes plain `python bench.py`; on

@@ -75,6 +75,13 @@ class ModelConfig:
     compute_dtype: str = "float32"
     # use the Pallas fused GRU kernel when running on TPU
     use_pallas: bool = False
+    # rematerialise the embed->fc2 front-end in the training backward
+    # (jax.checkpoint): trades ~3 ms of recompute for ~1.8 GB of stored
+    # activations + dropout masks per batch-512 step — the measured
+    # train-step bottleneck is HBM residual traffic, not FLOPs
+    # (BASELINE.md "training backward anomaly"). Off by default until
+    # the driver-measured bench row (train_gru_remat) proves it on chip.
+    remat_frontend: bool = False
 
     @property
     def gru_in_size(self) -> int:

@@ -110,22 +110,39 @@ class RokoModel:
         # output element, so it is BIT-identical to jnp.take — and both
         # its forward and its backward (the train-step hot spot: a
         # 9.2M-row scatter-add) become MXU GEMMs.
-        onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
-        w1 = params["fc1"]["kernel"].astype(dtype)  # [200, J]
         if train:
-            # The per-element dropout between embed and fc1 (reference
-            # placement, roko/rnn_model.py:47-49) forces materialising e,
-            # so the inference-only reassociation below can't be used
-            # here; the read-axis contraction is left to einsum so XLA
-            # picks the layout instead of paying an explicit 920 MB
-            # transpose.
-            e = jnp.einsum(
-                "brtv,vd->brtd", onehot, params["embedding"].astype(dtype)
-            )  # [B,200,90,50]
-            e = _dropout(rngs[0], e, cfg.dropout)
-            h = jnp.einsum("brtd,rj->btdj", e, w1)
-            h = jax.nn.relu(h + params["fc1"]["bias"].astype(dtype))
-            h = _dropout(rngs[1], h, cfg.dropout)
+
+            def _front(p_sub, x, r0, r1, r2):
+                # The per-element dropout between embed and fc1
+                # (reference placement, roko/rnn_model.py:47-49) forces
+                # materialising e, so the inference-only reassociation
+                # below can't be used here; the read-axis contraction is
+                # left to einsum so XLA picks the layout instead of
+                # paying an explicit 920 MB transpose. The one-hot is
+                # computed inside so a remat boundary stores only the
+                # uint8 x, not 221 MB of one-hot.
+                onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
+                e = jnp.einsum(
+                    "brtv,vd->brtd", onehot, p_sub["embedding"]
+                )  # [B,200,90,50]
+                e = _dropout(r0, e, cfg.dropout)
+                h = jnp.einsum("brtd,rj->btdj", e, p_sub["fc1"]["kernel"])
+                h = jax.nn.relu(h + p_sub["fc1"]["bias"])
+                h = _dropout(r1, h, cfg.dropout)
+                h = jax.nn.relu(_dense(p_sub["fc2"], h))
+                return _dropout(r2, h, cfg.dropout)
+
+            p_sub = {
+                "embedding": params["embedding"].astype(dtype),
+                "fc1": cast_tree(params["fc1"], dtype),
+                "fc2": cast_tree(params["fc2"], dtype),
+            }
+            # remat: recompute this chain in the backward (same rngs ->
+            # identical masks, identical values) instead of streaming
+            # ~1.8 GB of activations + masks through HBM per batch-512
+            # step; see ModelConfig.remat_frontend
+            front = jax.checkpoint(_front) if cfg.remat_frontend else _front
+            h = front(p_sub, x, rngs[0], rngs[1], rngs[2])
         else:
             # Inference fast path: embedding-gather + transpose + fc1 is
             # algebraically  relu(E[x]^T(r-axis) @ W1 + b1)  =
@@ -136,14 +153,14 @@ class RokoModel:
             # as the reference chain (roko/rnn_model.py:47-51) up to float
             # summation order; only valid without the per-element dropout
             # between embed and fc1, hence inference-only.
+            onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
+            w1 = params["fc1"]["kernel"].astype(dtype)  # [200, J]
             # contract the read axis first: [B,T,V,J]
             m = jnp.einsum("brtv,rj->btvj", onehot, w1)
             emb = params["embedding"].astype(dtype)  # [V, D]
             h = jnp.einsum("vd,btvj->btdj", emb, m)  # [B,T,D,J]
             h = jax.nn.relu(h + params["fc1"]["bias"].astype(dtype))
-        h = jax.nn.relu(_dense(cast_tree(params["fc2"], dtype), h))
-        if train:
-            h = _dropout(rngs[2], h, cfg.dropout)
+            h = jax.nn.relu(_dense(cast_tree(params["fc2"], dtype), h))
 
         # [B,90,50,10] -> [B,90,500]; row-major flatten matches the
         # reference's .reshape(-1, 90, 500)

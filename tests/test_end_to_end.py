@@ -118,3 +118,16 @@ def test_polish_reduces_draft_error(tmp_path):
     assert draft_err > 0.004  # fixture sanity: the draft is actually bad
     # the polish must remove the bulk of the draft error
     assert pol_err < draft_err / 3, (draft_err, pol_err)
+
+    # the framework's own evaluator (roko-tpu assess) must agree: the
+    # polished Qscore beats the draft's, measured alignment-exactly —
+    # this is the reference's full pomoxis workflow closed in-framework
+    from roko_tpu.eval.assess import assess_pair
+
+    draft_q = assess_pair(
+        truth_b.encode(), draft_b.encode(), truth_name="eval"
+    )
+    pol_q = assess_pair(
+        truth_b.encode(), polished.encode(), truth_name="eval"
+    )
+    assert pol_q.error_rate < draft_q.error_rate / 3, (draft_q, pol_q)
