@@ -14,10 +14,12 @@ best-of headline windows/s + ``best_batch``, model FLOPs/window, and an
 MFU estimate — a per-path failure is *reported* in
 ``detail.batch_sweep.<batch>.{scan,pallas}_error``, never swallowed.
 
-``python -m roko_tpu bench --train`` additionally times the
-training step for the flagship GRU, the 4-layer/2x-hidden scan-depth
-stress, and the transformer variant (BASELINE.json configs[1]/[3]/[4])
-and writes ``BENCHMARKS.json`` for the BASELINE.md table.
+``python -m roko_tpu bench --train`` additionally times the training
+step for the flagship GRU (plus its remat and fused-Pallas A/Bs), the
+4-layer/2x-hidden scan-depth stress, and the transformer variant
+(BASELINE.json configs[1]/[3]/[4]) under ``detail.train``;
+``--features`` times host-side extraction; ``--out`` writes the full
+result object to a JSON file for the BASELINE.md table.
 
 Each window advances the genome by WINDOW_STRIDE=30 columns, so
 bases/sec = windows/sec x 30 (SURVEY.md §5.7 window decomposition).
