@@ -108,7 +108,7 @@ def _build_config(args: argparse.Namespace):
     train = over(
         base.train,
         batch_size="b", epochs="epochs", lr="lr", patience="patience",
-        seed="seed", in_memory="memory",
+        seed="seed", in_memory="memory", val_fraction="val_fraction",
     )
     mesh = over(base.mesh, dp="dp", tp="tp", sp="sp")
     return RokoConfig(
@@ -144,20 +144,40 @@ def cmd_train(args: argparse.Namespace) -> int:
     return 0
 
 
-def cmd_inference(args: argparse.Namespace) -> int:
-    from roko_tpu.infer import polish_to_fasta
-    from roko_tpu.training.checkpoint import load_params
-
-    cfg = _build_config(args)
-    if args.model.endswith(".pth"):
+def _load_model_params(model_arg: str, cfg):
+    """Checkpoint resolution shared by inference/polish: native Orbax
+    dir/params, or a reference torch .pth through the converter."""
+    if model_arg.endswith(".pth"):
         from roko_tpu.models.convert import load_torch_checkpoint
 
-        params = load_torch_checkpoint(args.model, cfg.model)
-    else:
-        params = load_params(args.model)
+        return load_torch_checkpoint(model_arg, cfg.model)
+    from roko_tpu.training.checkpoint import load_params
+
+    return load_params(model_arg)
+
+
+def _print_assess(polished_path: str, truth_path: str, k: int = 16,
+                  json_path: str | None = None) -> None:
+    from roko_tpu.eval.assess import assess_fastas, format_report, write_json
+    from roko_tpu.io.fasta import read_fasta
+
+    truth = {n: s.encode() for n, s in read_fasta(truth_path)}
+    polished = {n: s.encode() for n, s in read_fasta(polished_path)}
+    res = assess_fastas(truth, polished, k=k)
+    print(format_report(res))
+    if json_path:
+        write_json(res, json_path)
+        print(f"wrote {json_path}")
+
+
+def cmd_inference(args: argparse.Namespace) -> int:
+    from roko_tpu.infer import polish_to_fasta
+
+    cfg = _build_config(args)
+    params = _load_model_params(args.model, cfg)
     polish_to_fasta(
         args.data, params, args.out, cfg,
-        batch_size=args.b if args.b is not None else cfg.train.batch_size,
+        batch_size=cfg.train.batch_size,  # --b layers in via _build_config
         # reference parity: --t sized the torch DataLoader worker pool
         # (ref: roko/inference.py:162); here the loader is a bounded
         # prefetch-thread pipeline, so --t sets its queue depth
@@ -197,20 +217,40 @@ def cmd_bench(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_polish(args: argparse.Namespace) -> int:
+    """One-shot draft -> polished: features + inference (+ assess when
+    --truth is given) in a single command. The reference needs two
+    manual stages plus external pomoxis for this workflow."""
+    import os
+    import tempfile
+
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.infer import polish_to_fasta
+
+    cfg = _build_config(args)
+    with tempfile.TemporaryDirectory() as td:
+        hdf5 = args.keep_hdf5 or os.path.join(td, "features.hdf5")
+        n = run_features(
+            args.ref, args.X, hdf5, workers=args.t, seed=args.seed, config=cfg
+        )
+        print(f"extracted {n} windows")
+        params = _load_model_params(args.model, cfg)
+        polish_to_fasta(
+            hdf5, params, args.out, cfg,
+            batch_size=cfg.train.batch_size,  # --b layers in via _build_config
+            prefetch=max(2, args.t),
+        )
+        print(f"wrote polished contigs to {args.out}")
+    if args.truth:
+        _print_assess(args.out, args.truth)
+    return 0
+
+
 def cmd_assess(args: argparse.Namespace) -> int:
     """Polished-vs-truth accuracy report (the reference obtains these
     numbers from the external pomoxis assess_assembly,
     ref README.md:97-112; here it is built in)."""
-    from roko_tpu.eval.assess import assess_fastas, format_report, write_json
-    from roko_tpu.io.fasta import read_fasta
-
-    truth = {n: s.encode() for n, s in read_fasta(args.truth)}
-    polished = {n: s.encode() for n, s in read_fasta(args.polished)}
-    res = assess_fastas(truth, polished, k=args.k)
-    print(format_report(res))
-    if args.json:
-        write_json(res, args.json)
-        print(f"wrote {args.json}")
+    _print_assess(args.polished, args.truth, k=args.k, json_path=args.json)
     return 0
 
 
@@ -235,6 +275,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("train", help="training HDF5 file or directory")
     p.add_argument("out", help="checkpoint output directory")
     p.add_argument("--val", default=None, help="validation HDF5 file or directory")
+    p.add_argument(
+        "--val-fraction", type=float, default=None,
+        help="without --val: hold out this fraction of training windows "
+        "for validation so early stopping works (seeded split)",
+    )
     p.add_argument("--b", type=int, default=None, help="global batch size (default 128)")
     p.add_argument("--epochs", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
@@ -303,6 +348,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--out", default=None, help="write full results JSON here")
     p.set_defaults(fn=cmd_bench)
+
+    p = sub.add_parser(
+        "polish",
+        help="one-shot: draft FASTA + BAM + checkpoint -> polished FASTA",
+    )
+    p.add_argument("ref", help="draft assembly FASTA")
+    p.add_argument("X", help="reads-to-draft BAM")
+    p.add_argument("model", help="checkpoint dir, saved params, or torch .pth")
+    p.add_argument("out", help="output FASTA path")
+    p.add_argument("--t", type=int, default=1, help="feature worker processes / loader prefetch")
+    p.add_argument("--b", type=int, default=None, help="inference batch size")
+    p.add_argument("--seed", type=int, default=0, help="row-sampling RNG seed")
+    p.add_argument("--truth", default=None, help="truth FASTA: print an assess report after polishing")
+    p.add_argument("--keep-hdf5", default=None, help="keep the intermediate features HDF5 at this path")
+    _config_arg(p)
+    _model_args(p)
+    _mesh_args(p)
+    _window_args(p)
+    p.set_defaults(fn=cmd_polish)
 
     p = sub.add_parser(
         "assess",

@@ -99,6 +99,10 @@ class TrainConfig:
     seed: int = 0
     #: keep the whole dataset resident in host RAM (ref: --memory flag)
     in_memory: bool = True
+    #: with no --val set, hold out this fraction of the training windows
+    #: for validation (seeded split) so early stopping still works;
+    #: 0.0 = no split, early stopping disabled without a val set
+    val_fraction: float = 0.0
     #: checkpoint directory keeps this many best checkpoints
     keep_checkpoints: int = 3
     #: number of host prefetch batches queued ahead of the device

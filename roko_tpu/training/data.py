@@ -38,6 +38,28 @@ class InMemoryDataset:
     def __len__(self) -> int:
         return len(self.X)
 
+    def split_holdout(
+        self, fraction: float, seed: int
+    ) -> Tuple["InMemoryDataset", "InMemoryDataset"]:
+        """Deterministic (train, val) split: a seeded permutation holds
+        out ``max(1, round(fraction * N))`` windows. Used when training
+        without an explicit --val set but with --val-fraction, so early
+        stopping has an honest metric (VERDICT r2 task #6)."""
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(f"val fraction must be in (0, 1), got {fraction}")
+        n = len(self)
+        n_val = max(1, round(fraction * n))
+        if n_val >= n:
+            raise ValueError(
+                f"val fraction {fraction} leaves no training windows (N={n})"
+            )
+        perm = np.random.default_rng(seed).permutation(n)
+        val_idx, train_idx = perm[:n_val], perm[n_val:]
+        return (
+            InMemoryDataset(self.X[train_idx], self.Y[train_idx]),
+            InMemoryDataset(self.X[val_idx], self.Y[val_idx]),
+        )
+
     def batches(
         self,
         batch_size: int,

@@ -230,6 +230,17 @@ def train(
 
         train_ds = StreamingDataset(train_path)
     val_ds = InMemoryDataset.from_path(val_path) if val_path else None
+    if val_ds is None and tcfg.val_fraction > 0:
+        if not tcfg.in_memory:
+            raise ValueError(
+                "--val-fraction needs the in-memory dataset (--memory); "
+                "pass an explicit --val set for streaming runs"
+            )
+        train_ds, val_ds = train_ds.split_holdout(tcfg.val_fraction, tcfg.seed)
+        log(
+            f"held out {len(val_ds)} of {len(train_ds) + len(val_ds)} "
+            "windows for validation (--val-fraction)"
+        )
     log(
         f"train windows: {len(train_ds)}"
         + (f", val windows: {len(val_ds)}" if val_ds else " (no val set)")

@@ -73,6 +73,38 @@ def test_cli_features_train_inference(tiny_project, capsys):
     assert polished and polished[0][0] == "ctg"
 
 
+def test_cli_polish_one_shot(tiny_project, tmp_path, capsys):
+    """polish = features + inference (+ assess with --truth) in one
+    command; reuses the checkpoint trained by the staged CLI test."""
+    root = tiny_project
+    ckpt = root / "ckpt"
+    if not ckpt.exists():  # independent of test ordering
+        main([
+            "features", str(root / "draft.fasta"), str(root / "reads.bam"),
+            str(root / "train.hdf5"), "--Y", str(root / "truth.bam"),
+            "--seed", "5",
+        ])
+        main([
+            "train", str(root / "train.hdf5"), str(ckpt),
+            "--b", "16", "--epochs", "2", "--lr", "1e-3",
+            "--hidden-size", "16", "--num-layers", "1", "--dp", "8",
+        ])
+        capsys.readouterr()
+    out = tmp_path / "polished_oneshot.fasta"
+    kept = tmp_path / "kept.hdf5"
+    rc = main([
+        "polish", str(root / "draft.fasta"), str(root / "reads.bam"),
+        str(ckpt), str(out), "--b", "16",
+        "--hidden-size", "16", "--num-layers", "1", "--dp", "8",
+        "--truth", str(root / "draft.fasta"), "--keep-hdf5", str(kept),
+    ])
+    assert rc == 0
+    text = capsys.readouterr().out
+    assert "extracted" in text and "TOTAL" in text  # assess report printed
+    assert out.exists() and kept.exists()
+    assert read_fasta(str(out))
+
+
 def test_cli_config_file_layering(tmp_path):
     """--config JSON is the base layer; explicit CLI flags override it;
     untouched flags defer to it."""

@@ -259,6 +259,52 @@ def test_no_val_disables_early_stopping(rng, tmp_path):
     assert int(jax.device_get(state.step)) == 4 * 2
 
 
+def test_val_fraction_holdout_enables_early_stopping(rng, tmp_path):
+    """--val-fraction splits a seeded holdout so patience has an honest
+    metric without an explicit --val set."""
+    from roko_tpu.training.data import InMemoryDataset
+
+    X, Y = _window_batch(rng, 40)
+    ds = InMemoryDataset(X, Y)
+    tr, va = ds.split_holdout(0.25, seed=3)
+    assert len(va) == 10 and len(tr) == 30
+    # deterministic and disjoint: same seed reproduces the same split
+    tr2, va2 = ds.split_holdout(0.25, seed=3)
+    assert np.array_equal(va.X, va2.X) and np.array_equal(tr.X, tr2.X)
+
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(
+            batch_size=16, epochs=3, lr=1e-6, patience=7, val_fraction=0.25
+        ),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    train(
+        cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+    assert any("held out 10" in l for l in logs)
+    assert not any("early stopping disabled" in l for l in logs)
+
+
+def test_val_fraction_requires_in_memory(rng, tmp_path):
+    import pytest as _pytest
+
+    X, Y = _window_batch(rng, 32)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(
+            batch_size=16, epochs=1, val_fraction=0.25, in_memory=False
+        ),
+        mesh=MeshConfig(dp=8),
+    )
+    with _pytest.raises(ValueError, match="val-fraction"):
+        train(cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"))
+
+
 def test_in_epoch_heartbeat(rng, tmp_path):
     """log_every_steps emits rate/ETA lines inside an epoch."""
     X, Y = _window_batch(rng, 64)
