@@ -346,7 +346,7 @@ def run_features_suite(
     rng = random.Random(0)
     bases = "ACGT"
     draft = "".join(rng.choice(bases) for _ in range(draft_len))
-    read_len = 3000
+    read_len = min(3000, max(100, draft_len // 4))
     records = []
     n_reads = draft_len * coverage // read_len
     for i in range(n_reads):

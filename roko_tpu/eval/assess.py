@@ -243,10 +243,11 @@ def assess_pair(
     if not anchors:
         # no common unique k-mers: align whole-vs-whole (tiny contigs)
         # or give up and count the truth as fully missing (honest
-        # worst case; a band over megabases would be meaningless)
+        # worst case; a band over megabases would be meaningless).
+        # _segment degrades to the worst case on MemoryError, so a
+        # pathological pair can't abort the whole report.
         if len(truth) * 2 < 1 << 20 and len(seq) * 2 < 1 << 20:
-            r = align_with_band_growth(truth, seq, pad=64)
-            _add(out, r)
+            _add(out, _segment(truth, seq))
         else:
             out.dele += len(truth)
             out.ins += len(seq)
@@ -329,8 +330,9 @@ def assess_fastas(
     Truth contigs with no partner are reported as fully deleted
     (polished assembly simply lacks them); extra polished contigs are
     ignored, matching the per-truth-base rate convention."""
-    truth = {n: s.upper() for n, s in truth.items()}
-    polished = {n: s.upper() for n, s in polished.items()}
+    # no .upper() here: assess_pair normalises case itself, and
+    # _kmer_codes (pairing) uppercases internally — doubling the copies
+    # of multi-megabase contigs buys nothing
     res = AssessResult()
     for tn, pn in _pair_contigs(truth, polished, k):
         if pn is None:

@@ -90,6 +90,34 @@ def test_oracle_band_edge_flag():
     assert r.dele == 4 and not r.hit_band_edge
 
 
+def _full_edit_distance(a: bytes, b: bytes) -> int:
+    """Textbook O(nm) Levenshtein, the independent ground truth."""
+    prev = list(range(len(b) + 1))
+    for i in range(1, len(a) + 1):
+        cur = [i] + [0] * len(b)
+        for j in range(1, len(b) + 1):
+            cur[j] = min(
+                prev[j] + 1,
+                cur[j - 1] + 1,
+                prev[j - 1] + (a[i - 1] != b[j - 1]),
+            )
+        prev = cur
+    return prev[-1]
+
+
+def test_banded_total_cost_equals_full_dp():
+    """With a band covering the whole matrix, sub+ins+del must equal the
+    unbanded Levenshtein distance on arbitrary (even unrelated) pairs."""
+    rng = random.Random(23)
+    for _ in range(20):
+        a = rand_seq(rng, rng.randrange(0, 60))
+        b = rand_seq(rng, rng.randrange(0, 60))
+        r = banded_align_py(a, b, pad=80)
+        assert r.errors == _full_edit_distance(a, b), (a, b)
+        assert r.match + r.sub + r.dele == len(a)
+        assert r.match + r.sub + r.ins == len(b)
+
+
 @pytest.mark.skipif(not binding.is_available(), reason="native lib unavailable")
 def test_native_matches_oracle_bitwise():
     rng = random.Random(11)
