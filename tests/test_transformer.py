@@ -111,6 +111,41 @@ def test_transformer_train_step_dp_tp(rng):
     assert delta > 0
 
 
+def test_transformer_overfits_tiny_batch(rng):
+    """Trainability, not just compilability: the variant must drive its
+    loss down overfitting one small batch (the GRU family has the
+    equivalent guarantee via test_training's convergence test)."""
+    import optax
+
+    from roko_tpu.training.loop import make_train_step
+
+    import dataclasses
+
+    mesh = make_mesh(MeshConfig(dp=-1, tp=1))
+    cfg = dataclasses.replace(TRANS, dropout=0.0)  # memorisation test
+    model = RokoModel(cfg)
+    tx = optax.adam(3e-3)
+    params = model.init(jax.random.PRNGKey(1))
+    from roko_tpu.training.loop import put_replicated
+
+    params = put_replicated(params, mesh)
+    opt_state = put_replicated(tx.init(params), mesh)
+    step = make_train_step(model, tx, mesh)
+
+    x = _x(rng)
+    y = rng.integers(0, C.NUM_CLASSES, (8, C.WINDOW_COLS)).astype(np.int32)
+    w = np.ones(8, np.float32)
+    drng = jax.random.PRNGKey(5)
+    first = None
+    for i in range(120):
+        params, opt_state, loss, acc = step(
+            params, opt_state, jnp.asarray(i, jnp.int32), x, y, w, drng
+        )
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5, (first, float(loss))
+
+
 def test_graft_entry_and_dryrun():
     import __graft_entry__ as ge
 
