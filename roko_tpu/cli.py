@@ -258,6 +258,11 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="roko-tpu", description="TPU-native genome assembly polisher"
     )
+    parser.add_argument(
+        "--version",
+        action="version",
+        version=f"roko-tpu {__import__('roko_tpu').__version__}",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
 
     p = sub.add_parser("features", help="FASTA + BAM -> features HDF5")
