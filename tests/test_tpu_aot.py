@@ -131,6 +131,44 @@ def test_dp_sharded_train_step_compiles_for_v5e_mesh(v5e_topo):
     step.lower(params, opt_state, step_no, x, y, w, rng).compile()
 
 
+def test_remat_train_step_compiles_for_v5e(v5e_topo):
+    """The remat_frontend train step (the bench's train_gru_remat A/B
+    row) compiled for real v5e hardware: jax.checkpoint + dropout
+    recompute must survive the XLA:TPU pipeline before the driver's
+    bench meets it on a chip."""
+    import optax
+    from jax.sharding import Mesh
+
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.parallel.mesh import (
+        AXIS_DP, AXIS_SP, AXIS_TP, data_sharding, replicated_sharding,
+    )
+    from roko_tpu.training.loop import make_train_step
+
+    mesh = Mesh(
+        np.array(v5e_topo.devices[:1]).reshape(1, 1, 1),
+        (AXIS_DP, AXIS_TP, AXIS_SP),
+    )
+    model = RokoModel(
+        ModelConfig(compute_dtype="bfloat16", remat_frontend=True)
+    )
+    tx = optax.adam(1e-4)
+    cpu_params = model.init(jax.random.PRNGKey(0))
+    repl = replicated_sharding(mesh)
+    data = data_sharding(mesh)
+    params = _abstract(cpu_params, jnp.float32, repl)
+    opt_state = _abstract(tx.init(cpu_params), None, repl)
+    step = make_train_step(model, tx, mesh)
+
+    B = 512
+    x = jax.ShapeDtypeStruct((B, 200, 90), jnp.uint8, sharding=data)
+    y = jax.ShapeDtypeStruct((B, 90), jnp.int32, sharding=data)
+    w = jax.ShapeDtypeStruct((B,), jnp.float32, sharding=data)
+    step_no = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
+    step.lower(params, opt_state, step_no, x, y, w, rng).compile()
+
+
 def test_transformer_tp_and_ring_sp_compile_for_v5e_mesh(v5e_topo):
     """The other two multi-chip configs the CPU dryrun exercises,
     compiled for real v5e hardware: dp x tp with Megatron-sharded
