@@ -94,11 +94,12 @@ def test_flagship_inference_step_compiles_for_v5e(v5e_sharding):
         monkeypatch.undo()
 
 
-def test_dp_sharded_train_step_compiles_for_v5e_mesh(v5e_topo):
-    """The full jitted train step (fwd+bwd+Adam, batch dp-sharded,
-    psum-all-reduced grads) compiled for a REAL 4-chip v5e topology —
-    stronger evidence than the CPU-mesh dryrun that the multi-chip path
-    lowers for hardware, including the ICI all-reduce."""
+def _compile_train_step_for(v5e_topo, mesh_shape, cfg, batch=512):
+    """AOT-compile the exact jitted production train step (fwd+bwd+Adam,
+    dp-sharded batch, psum grads) for a real v5e topology with the given
+    mesh shape and model config. dtype=None abstraction preserves Adam's
+    int32 count — the compile must cover the exact program production
+    runs."""
     import optax
     from jax.sharding import Mesh
 
@@ -108,65 +109,46 @@ def test_dp_sharded_train_step_compiles_for_v5e_mesh(v5e_topo):
     )
     from roko_tpu.training.loop import make_train_step
 
+    n = int(np.prod(mesh_shape))
     mesh = Mesh(
-        np.array(v5e_topo.devices).reshape(4, 1, 1), (AXIS_DP, AXIS_TP, AXIS_SP)
+        np.array(v5e_topo.devices[:n]).reshape(mesh_shape),
+        (AXIS_DP, AXIS_TP, AXIS_SP),
     )
-    model = RokoModel(ModelConfig(compute_dtype="bfloat16"))
+    model = RokoModel(cfg)
     tx = optax.adam(1e-4)
     cpu_params = model.init(jax.random.PRNGKey(0))
     repl = replicated_sharding(mesh)
     data = data_sharding(mesh)
     params = _abstract(cpu_params, jnp.float32, repl)
-    # dtype=None preserves Adam's int32 count — the compile must cover
-    # the exact program production runs
     opt_state = _abstract(tx.init(cpu_params), None, repl)
     step = make_train_step(model, tx, mesh)
 
-    B = 512
-    x = jax.ShapeDtypeStruct((B, 200, 90), jnp.uint8, sharding=data)
-    y = jax.ShapeDtypeStruct((B, 90), jnp.int32, sharding=data)
-    w = jax.ShapeDtypeStruct((B,), jnp.float32, sharding=data)
+    x = jax.ShapeDtypeStruct((batch, 200, 90), jnp.uint8, sharding=data)
+    y = jax.ShapeDtypeStruct((batch, 90), jnp.int32, sharding=data)
+    w = jax.ShapeDtypeStruct((batch,), jnp.float32, sharding=data)
     step_no = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
     rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
     step.lower(params, opt_state, step_no, x, y, w, rng).compile()
+
+
+def test_dp_sharded_train_step_compiles_for_v5e_mesh(v5e_topo):
+    """The full jitted train step compiled for a REAL 4-chip v5e
+    topology — stronger evidence than the CPU-mesh dryrun that the
+    multi-chip path lowers for hardware, including the ICI all-reduce."""
+    _compile_train_step_for(
+        v5e_topo, (4, 1, 1), ModelConfig(compute_dtype="bfloat16")
+    )
 
 
 def test_remat_train_step_compiles_for_v5e(v5e_topo):
     """The remat_frontend train step (the bench's train_gru_remat A/B
-    row) compiled for real v5e hardware: jax.checkpoint + dropout
-    recompute must survive the XLA:TPU pipeline before the driver's
-    bench meets it on a chip."""
-    import optax
-    from jax.sharding import Mesh
-
-    from roko_tpu.models.model import RokoModel
-    from roko_tpu.parallel.mesh import (
-        AXIS_DP, AXIS_SP, AXIS_TP, data_sharding, replicated_sharding,
+    row): jax.checkpoint + dropout recompute must survive the XLA:TPU
+    pipeline before the driver's bench meets it on a chip."""
+    _compile_train_step_for(
+        v5e_topo,
+        (1, 1, 1),
+        ModelConfig(compute_dtype="bfloat16", remat_frontend=True),
     )
-    from roko_tpu.training.loop import make_train_step
-
-    mesh = Mesh(
-        np.array(v5e_topo.devices[:1]).reshape(1, 1, 1),
-        (AXIS_DP, AXIS_TP, AXIS_SP),
-    )
-    model = RokoModel(
-        ModelConfig(compute_dtype="bfloat16", remat_frontend=True)
-    )
-    tx = optax.adam(1e-4)
-    cpu_params = model.init(jax.random.PRNGKey(0))
-    repl = replicated_sharding(mesh)
-    data = data_sharding(mesh)
-    params = _abstract(cpu_params, jnp.float32, repl)
-    opt_state = _abstract(tx.init(cpu_params), None, repl)
-    step = make_train_step(model, tx, mesh)
-
-    B = 512
-    x = jax.ShapeDtypeStruct((B, 200, 90), jnp.uint8, sharding=data)
-    y = jax.ShapeDtypeStruct((B, 90), jnp.int32, sharding=data)
-    w = jax.ShapeDtypeStruct((B,), jnp.float32, sharding=data)
-    step_no = jax.ShapeDtypeStruct((), jnp.int32, sharding=repl)
-    rng = jax.ShapeDtypeStruct((2,), jnp.uint32, sharding=repl)
-    step.lower(params, opt_state, step_no, x, y, w, rng).compile()
 
 
 def test_transformer_tp_and_ring_sp_compile_for_v5e_mesh(v5e_topo):

@@ -1,0 +1,194 @@
+"""On-chip perf bisect for the train-step backward anomaly.
+
+Round-3 measurements (v5e, batch 512, bf16 — BASELINE.md "training
+backward anomaly"): train forward 28.8 ms but fwd+bwd 173 ms (~6x);
+isolated probes put the front-end fwd+bwd at ~260 ms and the GRU scan
+fwd+bwd at ~181 ms standalone — both far above their FLOP/bandwidth
+cost, pointing at HBM residual streams. The chip died before the
+candidate fixes could be measured; this script packages the whole
+bisect so the next live-hardware session answers it in one run:
+
+    python tools/perf_probe.py            # full bisect, ~6 min
+    python tools/perf_probe.py --quick    # train-step A/Bs only
+
+Rows reported:
+  train_step[, +remat][, +pallas]  — full step A/Bs (jit, donated)
+  fwd_loss                          — train-mode forward only
+  front fwd / fwd+bwd               — embed->fc2 chain in isolation
+  gru fwd / fwd+bwd                 — scan recurrence in isolation
+
+Run it ONLY when the chip is healthy (see .claude/skills/verify
+gotchas: never timeout-kill a TPU process; check `ss -tln` for
+listeners on 8082-8117 first).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+# runnable as `python tools/perf_probe.py` without installation
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _timeit(name, f, *a, iters=10, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        jax.tree.map(np.asarray, f(*a))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(*a)
+    jax.tree.map(np.asarray, out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:>24}: {dt * 1e3:8.2f} ms")
+    return dt
+
+
+def train_step_rows(batch):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import MeshConfig, ModelConfig
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.parallel.mesh import make_mesh
+    from roko_tpu.training.loop import create_state, make_train_step
+
+    mesh = make_mesh(MeshConfig(dp=-1))
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, C.FEATURE_VOCAB, (batch, C.WINDOW_ROWS, C.WINDOW_COLS)).astype(np.uint8)
+    y = rng.integers(0, C.NUM_CLASSES, (batch, C.WINDOW_COLS)).astype(np.int32)
+    w = np.ones((batch,), np.float32)
+    variants = {
+        "train_step": ModelConfig(compute_dtype="bfloat16"),
+        "train_step+remat": ModelConfig(compute_dtype="bfloat16", remat_frontend=True),
+    }
+    from roko_tpu.models.gru import _pallas_backend
+
+    if _pallas_backend():
+        variants["train_step+pallas"] = ModelConfig(
+            compute_dtype="bfloat16", use_pallas=True
+        )
+        variants["train_step+remat+pallas"] = ModelConfig(
+            compute_dtype="bfloat16", remat_frontend=True, use_pallas=True
+        )
+    else:
+        print("(pallas rows skipped: backend is not TPU, the flag would "
+              "silently time the scan path)")
+    for name, cfg in variants.items():
+        model = RokoModel(cfg)
+        tx = optax.adam(1e-4)
+        state = create_state(model, tx, jax.random.PRNGKey(0))
+        step = make_train_step(model, tx, mesh)
+        params, opt = state.params, state.opt_state
+        sn = jnp.zeros((), jnp.int32)
+        dr = jax.random.PRNGKey(1)
+        # donation consumes params/opt, so time a self-feeding loop
+        for _ in range(3):
+            params, opt, loss, _ = step(params, opt, sn, x, y, w, dr)
+            np.asarray(loss)
+        t0 = time.perf_counter()
+        for _ in range(10):
+            params, opt, loss, _ = step(params, opt, sn, x, y, w, dr)
+        np.asarray(loss)
+        print(f"{name:>24}: {(time.perf_counter() - t0) / 10 * 1e3:8.2f} ms")
+
+
+def component_rows(batch):
+    import jax
+    import jax.numpy as jnp
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import ModelConfig
+    from roko_tpu.models.gru import bidir_gru_stack
+    from roko_tpu.models.layers import cast_tree, dense as _dense, dropout as _drop
+    from roko_tpu.models.model import RokoModel
+
+    cfg = ModelConfig(compute_dtype="bfloat16")
+    model = RokoModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jax.device_put(
+        rng.integers(0, C.FEATURE_VOCAB, (batch, C.WINDOW_ROWS, C.WINDOW_COLS)).astype(np.uint8)
+    )
+    y = jax.device_put(
+        rng.integers(0, C.NUM_CLASSES, (batch, C.WINDOW_COLS)).astype(np.int32)
+    )
+    dr = jax.random.PRNGKey(1)
+
+    @jax.jit
+    def fwd_loss(p, x, y, dr):
+        logits = model.apply(p, x, deterministic=False, rng=dr)
+        lp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(lp, y[..., None], axis=-1).mean()
+
+    _timeit("fwd_loss", fwd_loss, params, x, y, dr)
+    _timeit("fwd_loss grad", jax.jit(jax.grad(fwd_loss)), params, x, y, dr)
+
+    def front_loss(p, x, dr):
+        dtype = jnp.bfloat16
+        rngs = list(jax.random.split(dr, 4))
+        onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
+        e = jnp.einsum("brtv,vd->brtd", onehot, p["embedding"].astype(dtype))
+        e = _drop(rngs[0], e, cfg.dropout)
+        h = jnp.einsum("brtd,rj->btdj", e, p["fc1"]["kernel"].astype(dtype))
+        h = jax.nn.relu(h + p["fc1"]["bias"].astype(dtype))
+        h = _drop(rngs[1], h, cfg.dropout)
+        h = jax.nn.relu(_dense(cast_tree(p["fc2"], dtype), h))
+        h = _drop(rngs[2], h, cfg.dropout)
+        return h.astype(jnp.float32).sum()
+
+    _timeit("front fwd", jax.jit(front_loss), params, x, dr)
+    _timeit("front fwd+bwd", jax.jit(jax.grad(front_loss)), params, x, dr)
+
+    h_in = jax.device_put(
+        np.random.default_rng(1).standard_normal((batch, 90, 500)).astype(np.float32)
+    )
+    gp = params["gru"]
+
+    def gru_loss(gp, h):
+        # train-mode: inter-layer dropout masks are part of the residual
+        # traffic being bisected (torch.nn.GRU dropout placement)
+        return (
+            bidir_gru_stack(
+                cast_tree(gp, jnp.bfloat16),
+                h.astype(jnp.bfloat16),
+                dropout=cfg.dropout,
+                deterministic=False,
+                rng=jax.random.PRNGKey(7),
+            )
+            .astype(jnp.float32)
+            .sum()
+        )
+
+    _timeit("gru fwd", jax.jit(gru_loss), gp, h_in)
+    _timeit("gru fwd+bwd", jax.jit(jax.grad(gru_loss)), gp, h_in)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--quick", action="store_true", help="train-step A/Bs only")
+    args = ap.parse_args()
+    # JAX_PLATFORMS must win over a sitecustomize-registered TPU backend
+    # (JAX_PLATFORMS=cpu runs the probe off-chip for smoke tests)
+    from roko_tpu.cli import _honor_jax_platforms_env
+
+    _honor_jax_platforms_env()
+    import jax
+
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+    train_step_rows(args.batch)
+    if not args.quick:
+        component_rows(args.batch)
+
+
+if __name__ == "__main__":
+    main()
