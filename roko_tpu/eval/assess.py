@@ -20,7 +20,10 @@ pomoxis', not k-mer estimates.
 Conventions: rates are per truth base (``errors / truth_len``);
 ``Qscore = -10 log10(total_error_rate)``, infinite for a perfect
 match. Deletion = truth base missing from the polished sequence;
-insertion = polished base absent from truth.
+insertion = polished base absent from truth. ``N`` bases in the truth
+break anchors and compare as mismatches in aligned segments; their
+count is surfaced per contig (``truth_n``) and in the report so
+unknown-truth artefacts are distinguishable from polishing errors.
 """
 
 from __future__ import annotations
@@ -147,6 +150,11 @@ class ContigAssessment:
     dele: int = 0
     anchors: int = 0
     band_capped_segments: int = 0
+    #: 'N' bases in the truth contig: they break anchors and compare as
+    #: mismatches in aligned segments (the polished sequence is ACGT
+    #: only), so up to this many reported errors may be unknown-truth
+    #: artefacts rather than polishing mistakes
+    truth_n: int = 0
 
     @property
     def errors(self) -> int:
@@ -202,6 +210,7 @@ class AssessResult:
             "insertion_pct": round(100.0 * self._total("ins") / t, 4),
             "qscore": None if math.isinf(q) else round(q, 2),
             "band_capped_segments": self._total("band_capped_segments"),
+            "truth_n_bases": self._total("truth_n"),
             "unpaired_truth_contigs": [
                 c.truth_name for c in self.contigs if c.polished_name is None
             ],
@@ -239,6 +248,7 @@ def assess_pair(
         polished_len=len(polished),
         reverse_complemented=rc,
         anchors=len(anchors),
+        truth_n=truth.count(b"N"),
     )
     if not anchors:
         # no common unique k-mers: align whole-vs-whole (tiny contigs)
@@ -342,6 +352,7 @@ def assess_fastas(
                     polished_name=None,
                     truth_len=len(truth[tn]),
                     dele=len(truth[tn]),
+                    truth_n=truth[tn].upper().count(b"N"),
                 )
             )
         else:
@@ -392,6 +403,12 @@ def format_report(res: AssessResult) -> str:
             f"note: {s['band_capped_segments']} segment(s) hit the band cap; "
             "rates there are upper bounds"
         )
+    if s["truth_n_bases"]:
+        lines.append(
+            f"note: truth contains {s['truth_n_bases']} N base(s); each "
+            "aligned N counts as a mismatch (unknown truth, not "
+            "necessarily a polishing error)"
+        )
     return "\n".join(lines)
 
 
@@ -411,6 +428,7 @@ def write_json(res: AssessResult, path: str) -> None:
                 "insertion": c.ins,
                 "anchors": c.anchors,
                 "band_capped_segments": c.band_capped_segments,
+                "truth_n": c.truth_n,
                 "error_rate": c.error_rate,
                 "qscore": None if math.isinf(c.qscore) else c.qscore,
             }

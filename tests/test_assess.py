@@ -162,6 +162,21 @@ def test_assess_soft_masked_truth_is_not_an_error():
     assert c.errors == 0 and math.isinf(c.qscore)
 
 
+def test_assess_reports_truth_n_bases():
+    rng = random.Random(41)
+    truth = bytearray(rand_seq(rng, 3_000))
+    truth[1000:1005] = b"NNNNN"
+    polished = bytes(truth).replace(b"N", b"A")
+    c = assess_pair(bytes(truth), polished)
+    assert c.truth_n == 5
+    # the aligned N's count as mismatches, and the report flags them
+    assert c.sub == 5
+    from roko_tpu.eval.assess import AssessResult, format_report
+
+    text = format_report(AssessResult(contigs=[c]))
+    assert "5 N base(s)" in text
+
+
 def test_assess_perfect_match_is_infinite_q():
     rng = random.Random(3)
     truth = rand_seq(rng, 5_000)
