@@ -70,16 +70,19 @@ def test_pallas_gru_fwd_and_bwd_compile_for_v5e(v5e_sharding):
     jax.jit(jax.grad(loss)).lower(params, x, ct).compile()
 
 
-def test_flagship_inference_step_compiles_for_v5e(v5e_sharding):
-    """The exact shape bench.py/infer.py run on the chip: bf16 one-hot
-    fast path + fused Pallas recurrence + argmax, batch 512."""
+@pytest.mark.parametrize("batch", [512, 2048])
+def test_flagship_inference_step_compiles_for_v5e(v5e_sharding, batch):
+    """The exact shapes bench.py/infer.py run on the chip: bf16 one-hot
+    fast path + fused Pallas recurrence + argmax, at BOTH batch sizes of
+    the bench's sweep (2048 exercises the multi-batch-block grid,
+    nb=8)."""
     from roko_tpu.models.model import RokoModel
 
     model = RokoModel(ModelConfig(compute_dtype="bfloat16", use_pallas=True))
     params = _abstract(
         model.init(jax.random.PRNGKey(0)), jnp.float32, v5e_sharding
     )
-    x = jax.ShapeDtypeStruct((512, 200, 90), jnp.uint8, sharding=v5e_sharding)
+    x = jax.ShapeDtypeStruct((batch, 200, 90), jnp.uint8, sharding=v5e_sharding)
 
     def predict(p, x):
         return jnp.argmax(model.apply(p, x, deterministic=True), axis=-1)
