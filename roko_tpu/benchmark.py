@@ -462,6 +462,13 @@ def main(argv=None) -> None:
         detail["features"] = run_features_suite()
     ref_windows_per_sec = bench_torch_reference()
     detail["torch_cpu_ref_windows_per_sec"] = round(ref_windows_per_sec, 1)
+    # provenance: which stack produced this artifact (BENCH_r{N}.json is
+    # compared across rounds; backend/device drift must be visible)
+    detail["env"] = {
+        "backend": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "jax": jax.__version__,
+    }
     windows_per_sec = detail["windows_per_sec"]
     result = {
         "metric": "polished_bases_per_sec_per_chip",
