@@ -70,6 +70,40 @@ def test_torch_parity():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+def test_pth_file_through_cli_convert_and_inference_loader(tmp_path):
+    """The migration path reference users take: a real ``.pth`` file on
+    disk loads via load_torch_checkpoint (the CLI's `convert` and the
+    `inference model.pth` routing both use it) and predicts identically
+    to the in-memory conversion."""
+    from roko_tpu.models.convert import load_torch_checkpoint
+    from roko_tpu.training.checkpoint import load_params, save_params
+
+    ref = _torch_reference_model()
+    pth = tmp_path / "ref.pth"
+    torch.save(ref.state_dict(), str(pth))
+
+    params = load_torch_checkpoint(str(pth))
+    model, batch = RokoModel(ModelConfig()), _batch()
+    want = np.asarray(model.apply(from_torch_state_dict(ref.state_dict()), batch))
+    got = np.asarray(model.apply(params, batch))
+    np.testing.assert_array_equal(got, want)
+
+    # the converted params round-trip through the native checkpoint
+    # format (the `convert` subcommand's flow)
+    save_params(str(tmp_path / "ckpt_converted"), params)
+    reloaded = load_params(str(tmp_path / "ckpt_converted"))
+    got2 = np.asarray(model.apply(reloaded, batch))
+    np.testing.assert_array_equal(got2, want)
+
+    # a non-checkpoint file is rejected with a clear error
+    bad = tmp_path / "bad.pth"
+    torch.save({"unrelated": torch.zeros(3)}, str(bad))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="state_dict"):
+        load_torch_checkpoint(str(bad))
+
+
 def test_torch_parity_gru_only():
     """Isolate the recurrence: 1-layer bidir GRU vs torch on random input."""
     from roko_tpu.models.gru import bidir_gru_stack
