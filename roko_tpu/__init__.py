@@ -11,11 +11,14 @@ A from-scratch reimplementation of the capabilities of lbcb-sci/roko
   inference steps sharded over a `jax.sharding.Mesh` (dp/tp/sp axes) with
   XLA collectives over ICI.
 
-Pipeline (mirrors the reference's three CLI stages, ref: README.md:7):
+Pipeline (mirrors the reference's three CLI stages, ref: README.md:7,
+plus built-in evaluation the reference delegates to external pomoxis):
 
     roko-tpu features   FASTA + BAM [+ truth BAM]  ->  features.hdf5
     roko-tpu train      features.hdf5 dir          ->  orbax checkpoints
     roko-tpu inference  features.hdf5 + checkpoint ->  polished.fasta
+    roko-tpu assess     polished + truth FASTA     ->  error rates + Qscore
+    roko-tpu polish     one-shot features + inference [+ assess]
 """
 
 __version__ = "0.1.0"
