@@ -1,295 +1,18 @@
-"""Shared test fixtures: tiny synthetic genomes, read simulation, and BAM
-fixture construction (the reference ships no tests — SURVEY.md §4 defines
-this strategy: synthetic FASTA+BAM fixtures driving the extractor)."""
+"""Test fixtures are the public simulation module — re-exported so test
+imports stay stable (the simulator graduated to ``roko_tpu.sim`` because
+the benchmark, the verify recipe, and examples/ use it too)."""
 
-from __future__ import annotations
-
-import random
-from typing import List, Optional, Sequence, Tuple
-
-from roko_tpu import constants as C
-from roko_tpu.io.bam import BamRecord
-
-BASES = "ACGT"
-
-
-def random_seq(rng: random.Random, n: int) -> str:
-    return "".join(rng.choice(BASES) for _ in range(n))
-
-
-def mutate(
-    rng: random.Random,
-    seq: str,
-    sub_rate: float = 0.0,
-    ins_rate: float = 0.0,
-    del_rate: float = 0.0,
-    max_indel: int = 3,
-) -> str:
-    """Apply random substitutions/insertions/deletions — used to derive a
-    'draft' from a 'truth' genome or noisy reads from a template."""
-    out = []
-    i = 0
-    while i < len(seq):
-        r = rng.random()
-        if r < del_rate:
-            i += rng.randint(1, max_indel)
-            continue
-        b = seq[i]
-        if r < del_rate + sub_rate:
-            b = rng.choice([x for x in BASES if x != seq[i]])
-        out.append(b)
-        if rng.random() < ins_rate:
-            out.append(random_seq(rng, rng.randint(1, max_indel)))
-        i += 1
-    return "".join(out)
-
-
-def align_to_ref(query: str, ref: str, ref_start: int) -> Tuple[int, Tuple[Tuple[int, int], ...]]:
-    """Trivial gapless alignment helper: full-length M at ref_start."""
-    return ref_start, ((C.CIGAR_M, len(query)),)
-
-
-def make_record(
-    name: str,
-    tid: int,
-    pos: int,
-    seq: str,
-    cigar: Sequence[Tuple[int, int]],
-    flag: int = 0,
-    mapq: int = 60,
-) -> BamRecord:
-    return BamRecord(
-        name=name,
-        flag=flag,
-        tid=tid,
-        pos=pos,
-        mapq=mapq,
-        cigar=tuple(cigar),
-        seq=seq,
-        qual=b"I" * len(seq),
-    )
-
-
-def cigar_from_string(s: str) -> Tuple[Tuple[int, int], ...]:
-    """Parse '5M2I3M' into ((M,5),(I,2),(M,3))."""
-    out: List[Tuple[int, int]] = []
-    num = ""
-    for ch in s:
-        if ch.isdigit():
-            num += ch
-        else:
-            out.append((C.CIGAR_OPS.index(ch), int(num)))
-            num = ""
-    return tuple(out)
-
-
-def query_len_for_cigar(cigar: Sequence[Tuple[int, int]]) -> int:
-    return sum(l for op, l in cigar if C.CIGAR_CONSUMES_QUERY[op])
-
-
-def simulate_reads(
-    rng: random.Random,
-    ref: str,
-    tid: int,
-    coverage: int = 30,
-    read_len: int = 200,
-    sub_rate: float = 0.02,
-    ins_rate: float = 0.01,
-    del_rate: float = 0.01,
-) -> List[BamRecord]:
-    """Simulate noisy reads from `ref` with known (exact) alignments: errors
-    are introduced with matching CIGAR ops, so the BAM is self-consistent
-    without needing an aligner."""
-    n_reads = max(1, coverage * len(ref) // read_len)
-    records = []
-    for ridx in range(n_reads):
-        start = rng.randrange(0, max(1, len(ref) - read_len))
-        end = min(len(ref), start + read_len)
-        seq_parts: List[str] = []
-        cigar: List[Tuple[int, int]] = []
-
-        def push(op: int, length: int):
-            if length <= 0:
-                return
-            if cigar and cigar[-1][0] == op:
-                cigar[-1] = (op, cigar[-1][1] + length)
-            else:
-                cigar.append((op, length))
-
-        i = start
-        while i < end:
-            r = rng.random()
-            if r < del_rate and i > start:
-                d = rng.randint(1, 2)
-                d = min(d, end - i)
-                push(C.CIGAR_D, d)
-                i += d
-                continue
-            b = ref[i]
-            if r < del_rate + sub_rate:
-                b = rng.choice([x for x in BASES if x != ref[i]])
-            seq_parts.append(b)
-            push(C.CIGAR_M, 1)
-            if rng.random() < ins_rate:
-                ins = random_seq(rng, rng.randint(1, 2))
-                seq_parts.append(ins)
-                push(C.CIGAR_I, len(ins))
-            i += 1
-        seq = "".join(seq_parts)
-        if not seq:
-            continue
-        flag = C.FLAG_REVERSE if rng.random() < 0.5 else 0
-        records.append(
-            make_record(f"read{ridx}", tid, start, seq, cigar, flag=flag, mapq=60)
-        )
-    return records
-
-
-def mutate_with_cigar(
-    rng: random.Random,
-    truth: str,
-    sub_rate: float = 0.0,
-    ins_rate: float = 0.0,
-    del_rate: float = 0.0,
-    max_indel: int = 2,
-) -> Tuple[str, Tuple[Tuple[int, int], ...]]:
-    """Derive a 'draft' from ``truth`` and return the exact truth-to-draft
-    alignment CIGAR (query = truth, reference = draft).
-
-    Op mapping from the edit script: a substitution stays M; dropping a
-    truth base from the draft means truth has a base the draft lacks -> I
-    (query-only); extra bases inserted into the draft -> D (ref-only).
-    """
-    out: List[str] = []
-    cigar: List[Tuple[int, int]] = []
-
-    def push(op: int, length: int = 1):
-        if length <= 0:
-            return
-        if cigar and cigar[-1][0] == op:
-            cigar[-1] = (op, cigar[-1][1] + length)
-        else:
-            cigar.append((op, length))
-
-    for ch in truth:
-        r = rng.random()
-        if r < del_rate:  # draft lacks this truth base
-            push(C.CIGAR_I)
-            continue
-        b = ch
-        if r < del_rate + sub_rate:
-            b = rng.choice([x for x in BASES if x != ch])
-        out.append(b)
-        push(C.CIGAR_M)
-        if rng.random() < ins_rate:  # draft gains extra bases
-            ins = random_seq(rng, rng.randint(1, max_indel))
-            out.append(ins)
-            push(C.CIGAR_D, len(ins))
-    return "".join(out), tuple(cigar)
-
-
-def truth_to_draft_map(cigar: Sequence[Tuple[int, int]]) -> List[int]:
-    """Per truth position, the draft position it aligns to, or -1 for
-    truth-only bases (I ops). CIGAR orientation as mutate_with_cigar."""
-    t2d: List[int] = []
-    d = 0
-    for op, length in cigar:
-        if op == C.CIGAR_M:
-            for _ in range(length):
-                t2d.append(d)
-                d += 1
-        elif op == C.CIGAR_I:  # truth-only
-            t2d.extend([-1] * length)
-        elif op == C.CIGAR_D:  # draft-only
-            d += length
-    return t2d
-
-
-def compose_read_to_draft(
-    read_pos_t: int,
-    read_cigar: Sequence[Tuple[int, int]],
-    t2d: Sequence[int],
-) -> Optional[Tuple[int, Tuple[Tuple[int, int], ...]]]:
-    """Re-map a read aligned to truth (at ``read_pos_t`` with
-    ``read_cigar``) onto the draft via the truth->draft map.
-
-    Returns (draft_pos, cigar) or None when the read never touches a
-    mapped draft base. Leading/trailing query bases that end up unmapped
-    become soft clips; draft-only bases inside the span become D.
-    """
-    events: List[Tuple[int, int]] = []  # (op, length) pre-merge
-
-    def push(op: int, length: int = 1):
-        if length <= 0:
-            return
-        if events and events[-1][0] == op:
-            events[-1] = (op, events[-1][1] + length)
-        else:
-            events.append((op, length))
-
-    t = read_pos_t
-    start_d = None
-    last_d = None
-
-    def advance_draft(to_d: int):
-        nonlocal last_d
-        if last_d is not None and to_d > last_d + 1:
-            push(C.CIGAR_D, to_d - last_d - 1)  # draft-only bases between
-        last_d = to_d
-
-    for op, length in read_cigar:
-        if op in (C.CIGAR_M, C.CIGAR_EQ, C.CIGAR_X):
-            for _ in range(length):
-                d = t2d[t] if t < len(t2d) else -1
-                if d < 0:
-                    push(C.CIGAR_I)  # aligned to a truth-only base
-                else:
-                    if start_d is None:
-                        start_d = d
-                    advance_draft(d)
-                    push(C.CIGAR_M)
-                t += 1
-        elif op == C.CIGAR_I:
-            push(C.CIGAR_I, length)
-        elif op == C.CIGAR_D:
-            for _ in range(length):
-                d = t2d[t] if t < len(t2d) else -1
-                if d >= 0:
-                    if start_d is None:
-                        # deletion before any aligned base: skip, the
-                        # alignment will start at the next M
-                        pass
-                    else:
-                        advance_draft(d)
-                        push(C.CIGAR_D)
-                t += 1
-        elif op == C.CIGAR_S:
-            push(C.CIGAR_S, length)
-
-    if start_d is None:
-        return None
-    # leading I (query bases before the first draft-aligned base) -> S
-    out: List[Tuple[int, int]] = []
-    for i, (op, length) in enumerate(events):
-        if op == C.CIGAR_M:
-            out.extend(events[i:])
-            break
-        if op in (C.CIGAR_I, C.CIGAR_S):
-            out.append((C.CIGAR_S, length))
-        # leading D: drop
-    # trailing I/D -> S / drop
-    while out and out[-1][0] in (C.CIGAR_I, C.CIGAR_D):
-        op, length = out.pop()
-        if op == C.CIGAR_I:
-            if out and out[-1][0] == C.CIGAR_S:
-                out[-1] = (C.CIGAR_S, out[-1][1] + length)
-            else:
-                out.append((C.CIGAR_S, length))
-    # merge any S+S introduced above
-    merged: List[Tuple[int, int]] = []
-    for op, length in out:
-        if merged and merged[-1][0] == op:
-            merged[-1] = (op, merged[-1][1] + length)
-        else:
-            merged.append((op, length))
-    return start_d, tuple(merged)
+from roko_tpu.sim import (  # noqa: F401
+    BASES,
+    align_to_ref,
+    build_synthetic_project,
+    cigar_from_string,
+    compose_read_to_draft,
+    make_record,
+    mutate,
+    mutate_with_cigar,
+    query_len_for_cigar,
+    random_seq,
+    simulate_reads,
+    truth_to_draft_map,
+)

@@ -187,3 +187,28 @@ def test_run_features_progress_log(synthetic):
     run_features(synthetic["fasta"], synthetic["bam_x"], out, workers=1,
                  seed=3, flush_every=1, log=lines.append)
     assert lines and any("regions" in l and "eta" in l for l in lines)
+
+
+def test_build_synthetic_project(tmp_path):
+    """The public project builder (examples + verify recipe data layer)
+    writes a self-consistent FASTA/BAM set."""
+    from roko_tpu.io.bam import BamReader
+    from roko_tpu.io.fasta import read_fasta
+    from roko_tpu.sim import build_synthetic_project
+
+    paths = build_synthetic_project(str(tmp_path / "proj"), genome_len=3000)
+    truth = dict(read_fasta(paths["truth_fasta"]))
+    draft = dict(read_fasta(paths["draft_fasta"]))
+    assert set(truth) == set(draft) == {paths["contig"]}
+    assert len(truth[paths["contig"]]) == 3000
+    with BamReader(paths["reads_bam"]) as r:
+        recs = list(r.fetch(paths["contig"], 0, len(draft[paths["contig"]])))
+    assert len(recs) > 100
+    # every record's CIGAR is query-consistent and within the draft
+    from roko_tpu import constants as C
+
+    for rec in recs:
+        qlen = sum(l for op, l in rec.cigar if C.CIGAR_CONSUMES_QUERY[op])
+        assert qlen == len(rec.seq)
+        rlen = sum(l for op, l in rec.cigar if C.CIGAR_CONSUMES_REF[op])
+        assert rec.pos + rlen <= len(draft[paths["contig"]])
