@@ -246,6 +246,30 @@ def cmd_polish(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_sim(args: argparse.Namespace) -> int:
+    """Write a synthetic polishing project (truth/draft FASTA +
+    reads/truth BAMs with exact alignments) — try the pipeline with no
+    external data, assembler, or aligner (roko_tpu/sim.py)."""
+    from roko_tpu.sim import build_synthetic_project
+
+    # default=None flags defer to build_synthetic_project's own defaults
+    # (this file's layering convention — no copied default values)
+    kwargs = {
+        k: v
+        for k, v in (
+            ("seed", args.seed),
+            ("genome_len", args.genome_len),
+            ("coverage", args.coverage),
+            ("read_len", args.read_len),
+        )
+        if v is not None
+    }
+    paths = build_synthetic_project(args.out_dir, **kwargs)
+    for k, v in paths.items():
+        print(f"{k}: {v}")
+    return 0
+
+
 def cmd_assess(args: argparse.Namespace) -> int:
     """Polished-vs-truth accuracy report (the reference obtains these
     numbers from the external pomoxis assess_assembly,
@@ -372,6 +396,17 @@ def build_parser() -> argparse.ArgumentParser:
     _mesh_args(p)
     _window_args(p)
     p.set_defaults(fn=cmd_polish)
+
+    p = sub.add_parser(
+        "sim",
+        help="write a synthetic truth/draft/reads project (no aligner needed)",
+    )
+    p.add_argument("out_dir")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--genome-len", type=int, default=None)
+    p.add_argument("--coverage", type=int, default=None)
+    p.add_argument("--read-len", type=int, default=None)
+    p.set_defaults(fn=cmd_sim)
 
     p = sub.add_parser(
         "assess",

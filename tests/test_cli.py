@@ -105,6 +105,17 @@ def test_cli_polish_one_shot(tiny_project, tmp_path, capsys):
     assert read_fasta(str(out))
 
 
+def test_cli_sim_writes_project(tmp_path, capsys):
+    rc = main(["sim", str(tmp_path / "proj"), "--genome-len", "2000",
+               "--coverage", "10", "--read-len", "200"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "draft_fasta" in out
+    for f in ("truth.fasta", "draft.fasta", "reads.bam", "reads.bam.bai",
+              "truth.bam"):
+        assert (tmp_path / "proj" / f).exists(), f
+
+
 def test_cli_config_file_layering(tmp_path):
     """--config JSON is the base layer; explicit CLI flags override it;
     untouched flags defer to it."""
