@@ -366,39 +366,45 @@ def run_features_suite(
         bam = os.path.join(td, "reads.bam")
         write_fasta(fasta, [("ctg", draft)])
         write_sorted_bam(bam, [("ctg", draft_len)], records)
-        for backend in ("native", "python"):
+        mp_workers = min(4, os.cpu_count() or 1)
+        runs = [
+            ("native", "0", 1),
+            # multicore scaling evidence (ThreadPool over regions); the
+            # Python oracle is skipped at >1 worker — GIL-bound, and the
+            # single-worker row already anchors the native-vs-Python gap
+            (f"native_t{mp_workers}", "0", mp_workers),
+            ("python", "1", 1),
+        ]
+        for name, force_py, workers in runs:
+            if name.startswith("native_t") and mp_workers == 1:
+                continue  # single-core host: the row would duplicate 'native'
             # the native pass must override, not merely not-set, the
             # force-python debug knob a user may have exported
-            env = {
-                "ROKO_TPU_FORCE_PY_EXTRACTOR": (
-                    "0" if backend == "native" else "1"
-                )
-            }
-            old = {k: os.environ.get(k) for k in env}
-            os.environ.update(env)
+            old = os.environ.get("ROKO_TPU_FORCE_PY_EXTRACTOR")
+            os.environ["ROKO_TPU_FORCE_PY_EXTRACTOR"] = force_py
             try:
                 t0 = time.perf_counter()
                 n = run_features(
                     fasta,
                     bam,
-                    os.path.join(td, f"{backend}.hdf5"),
+                    os.path.join(td, f"{name}.hdf5"),
                     seed=0,
+                    workers=workers,
                     log=lambda *a, **k: None,
                 )
                 dt = time.perf_counter() - t0
-                out[backend] = {
+                out[name] = {
                     "windows_per_sec": round(n / dt, 1),
                     "draft_bases_per_sec": round(draft_len / dt, 1),
                     "seconds": round(dt, 2),
                 }
             except Exception as e:
-                out[backend] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                out[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
             finally:
-                for k, v in old.items():
-                    if v is None:
-                        os.environ.pop(k, None)
-                    else:
-                        os.environ[k] = v
+                if old is None:
+                    os.environ.pop("ROKO_TPU_FORCE_PY_EXTRACTOR", None)
+                else:
+                    os.environ["ROKO_TPU_FORCE_PY_EXTRACTOR"] = old
     return out
 
 
