@@ -350,9 +350,7 @@ def run_features_suite(
     records = simulate_reads(
         rng, draft, 0, coverage=coverage, read_len=read_len
     )
-    out: Dict[str, Any] = {
-        "draft_len": draft_len, "coverage": coverage, "workers": 1,
-    }
+    out: Dict[str, Any] = {"draft_len": draft_len, "coverage": coverage}
     # build the native .so (if stale/missing) BEFORE the timed window, so
     # a clean host doesn't count the g++ compile as extraction time
     try:
@@ -394,6 +392,7 @@ def run_features_suite(
                 )
                 dt = time.perf_counter() - t0
                 out[name] = {
+                    "workers": workers,
                     "windows_per_sec": round(n / dt, 1),
                     "draft_bases_per_sec": round(draft_len / dt, 1),
                     "seconds": round(dt, 2),

@@ -270,6 +270,40 @@ def cmd_sim(args: argparse.Namespace) -> int:
     return 0
 
 
+def cmd_inspect(args: argparse.Namespace) -> int:
+    """Summarise a features HDF5 (or a directory of them): per-file
+    window counts, contigs, training labels present, total sizes —
+    the schema contract is documented in roko_tpu/data/hdf5.py."""
+    import h5py
+
+    from roko_tpu.data.hdf5 import data_group_names, hdf5_files
+
+    total_windows = 0
+    for path in hdf5_files(args.data):
+        with h5py.File(path, "r") as fd:
+            groups = data_group_names(fd)
+            windows = sum(fd[g]["examples"].shape[0] for g in groups)
+            labeled = sum("labels" in fd[g] for g in groups)
+            contigs = sorted(fd["contigs"].keys()) if "contigs" in fd else []
+            first = fd[groups[0]]["examples"] if groups else None
+            geom = f"{first.shape[1]}x{first.shape[2]}" if first is not None else "-"
+            total_windows += windows
+            kind = (
+                "EMPTY (no region groups)" if not groups
+                else "training" if labeled == len(groups)
+                else "inference" if labeled == 0
+                else f"mixed ({labeled}/{len(groups)} labeled)"
+            )
+            print(
+                f"{path}: {windows} windows ({geom}) in {len(groups)} "
+                f"region groups, {len(contigs)} contig(s) "
+                f"[{', '.join(contigs[:5])}{'...' if len(contigs) > 5 else ''}], "
+                f"{kind}"
+            )
+    print(f"total: {total_windows} windows")
+    return 0
+
+
 def cmd_assess(args: argparse.Namespace) -> int:
     """Polished-vs-truth accuracy report (the reference obtains these
     numbers from the external pomoxis assess_assembly,
@@ -396,6 +430,12 @@ def build_parser() -> argparse.ArgumentParser:
     _mesh_args(p)
     _window_args(p)
     p.set_defaults(fn=cmd_polish)
+
+    p = sub.add_parser(
+        "inspect", help="summarise a features HDF5 file or directory"
+    )
+    p.add_argument("data", help="features HDF5 file or directory")
+    p.set_defaults(fn=cmd_inspect)
 
     p = sub.add_parser(
         "sim",

@@ -105,6 +105,21 @@ def test_cli_polish_one_shot(tiny_project, tmp_path, capsys):
     assert read_fasta(str(out))
 
 
+def test_cli_inspect_summarises_hdf5(tiny_project, capsys):
+    root = tiny_project
+    if not (root / "train.hdf5").exists():
+        main([
+            "features", str(root / "draft.fasta"), str(root / "reads.bam"),
+            str(root / "train.hdf5"), "--Y", str(root / "truth.bam"),
+            "--seed", "5",
+        ])
+        capsys.readouterr()
+    rc = main(["inspect", str(root / "train.hdf5")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "windows (200x90)" in out and "training" in out and "total:" in out
+
+
 def test_cli_sim_writes_project(tmp_path, capsys):
     rc = main(["sim", str(tmp_path / "proj"), "--genome-len", "2000",
                "--coverage", "10", "--read-len", "200"])
