@@ -111,7 +111,10 @@ def _anchors(truth: bytes, polished: bytes, k: int = K) -> List[Tuple[int, int]]
     pc, pp = _unique_kmers(polished, k)
     if tc.size == 0 or pc.size == 0:
         return []
-    shared, ti, pi = np.intersect1d(tc, pc, return_indices=True)
+    # _unique_kmers outputs are unique by construction; skip the re-dedup
+    shared, ti, pi = np.intersect1d(
+        tc, pc, assume_unique=True, return_indices=True
+    )
     if shared.size == 0:
         return []
     tpos, ppos = tp[ti], pp[pi]
