@@ -220,14 +220,30 @@ def cmd_bench(args: argparse.Namespace) -> int:
 def cmd_polish(args: argparse.Namespace) -> int:
     """One-shot draft -> polished: features + inference (+ assess when
     --truth is given) in a single command. The reference needs two
-    manual stages plus external pomoxis for this workflow."""
+    manual stages plus external pomoxis for this workflow.
+
+    On a multi-host pod each process extracts features into its own
+    process-local temp file (redundant but correct; the staged
+    `features` + `inference` commands share one HDF5 instead) and
+    inference then shards contigs across processes as usual."""
     import os
     import tempfile
 
     from roko_tpu.features.pipeline import run_features
     from roko_tpu.infer import polish_to_fasta
+    from roko_tpu.parallel import distributed
 
+    distributed.initialize()  # idempotent; needed for the pod guard
     cfg = _build_config(args)
+    if args.keep_hdf5:
+        import jax
+
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "polish --keep-hdf5 is single-host only: every pod process "
+                "would write the same path on a shared filesystem. Run the "
+                "staged `features` + `inference` commands instead."
+            )
     with tempfile.TemporaryDirectory() as td:
         hdf5 = args.keep_hdf5 or os.path.join(td, "features.hdf5")
         n = run_features(
