@@ -157,17 +157,25 @@ def _load_model_params(model_arg: str, cfg):
 
 
 def _print_assess(polished_path: str, truth_path: str, k: int = 16,
-                  json_path: str | None = None) -> None:
-    from roko_tpu.eval.assess import assess_fastas, format_report, write_json
+                  json_path: str | None = None,
+                  bed_path: str | None = None) -> None:
+    from roko_tpu.eval.assess import (
+        assess_fastas, format_report, write_bed, write_json,
+    )
     from roko_tpu.io.fasta import read_fasta
 
     truth = {n: s.encode() for n, s in read_fasta(truth_path)}
     polished = {n: s.encode() for n, s in read_fasta(polished_path)}
-    res = assess_fastas(truth, polished, k=k)
+    res = assess_fastas(
+        truth, polished, k=k, collect_errors=bed_path is not None
+    )
     print(format_report(res))
     if json_path:
         write_json(res, json_path)
         print(f"wrote {json_path}")
+    if bed_path:
+        write_bed(res, bed_path)
+        print(f"wrote {bed_path}")
 
 
 def cmd_inference(args: argparse.Namespace) -> int:
@@ -324,7 +332,10 @@ def cmd_assess(args: argparse.Namespace) -> int:
     """Polished-vs-truth accuracy report (the reference obtains these
     numbers from the external pomoxis assess_assembly,
     ref README.md:97-112; here it is built in)."""
-    _print_assess(args.polished, args.truth, k=args.k, json_path=args.json)
+    _print_assess(
+        args.polished, args.truth, k=args.k, json_path=args.json,
+        bed_path=args.bed,
+    )
     return 0
 
 
@@ -472,6 +483,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("truth", help="truth/reference FASTA")
     p.add_argument("--k", type=int, default=16, help="anchor k-mer size")
     p.add_argument("--json", default=None, help="also write a JSON report here")
+    p.add_argument(
+        "--bed", default=None,
+        help="also write truth-space error loci (contig start end kind count)",
+    )
     p.set_defaults(fn=cmd_assess)
 
     return parser

@@ -32,6 +32,8 @@ class AlignResult:
     ins: int  # bases present only in ``b``
     dele: int  # bases of ``a`` missing from ``b``
     hit_band_edge: bool
+    #: error events in a-coordinates, only when requested (collect_ops)
+    ops: "list[tuple[str, int]] | None" = None
 
     @property
     def errors(self) -> int:
@@ -39,13 +41,31 @@ class AlignResult:
 
 
 def banded_align_py(
-    a: bytes, b: bytes, pad: int, max_cells: int = MAX_CELLS
+    a: bytes,
+    b: bytes,
+    pad: int,
+    max_cells: int = MAX_CELLS,
+    *,
+    collect_ops: bool = False,
 ) -> AlignResult:
     """Python reference DP; see module docstring. Raises MemoryError
-    when ``(len(a)+1) * band_width`` exceeds ``max_cells``."""
+    when ``(len(a)+1) * band_width`` exceeds ``max_cells``.
+
+    With ``collect_ops`` the result's ``ops`` lists the error events in
+    ``a``-coordinates, ordered by position: ``("sub", i)`` — ``a[i]``
+    substituted; ``("del", i)`` — ``a[i]`` missing from ``b``;
+    ``("ins", i)`` — extra ``b`` base(s) aligned before ``a[i]`` (i may
+    equal ``len(a)`` for a trailing insertion). Matches are omitted.
+    The assess tool uses this only on the (few) segments whose native
+    counts show errors, so the hot path stays in C++."""
     la, lb = len(a), len(b)
     if la == 0 or lb == 0:
-        return AlignResult(0, 0, lb, la, False)
+        res = AlignResult(0, 0, lb, la, False)
+        if collect_ops:
+            res.ops = (
+                [("ins", 0)] * lb if lb else []
+            ) + ([("del", i) for i in range(la)])
+        return res
     dlo = min(0, lb - la) - pad
     dhi = max(0, lb - la) + pad
     width = dhi - dlo + 1
@@ -91,6 +111,7 @@ def banded_align_py(
         raise RuntimeError("band does not contain the end cell")
 
     res = AlignResult(0, 0, 0, 0, False)
+    ops: list = [] if collect_ops else None  # type: ignore[assignment]
     i, w = la, end_w
     while i > 0 or i + dlo + w > 0:
         j = i + dlo + w
@@ -102,16 +123,25 @@ def banded_align_py(
                 res.match += 1
             else:
                 res.sub += 1
+                if collect_ops:
+                    ops.append(("sub", i - 1))
             i -= 1
         elif mv == UP:
             res.dele += 1
+            if collect_ops:
+                ops.append(("del", i - 1))
             i -= 1
             w += 1
         elif mv == LEFT:
             res.ins += 1
+            if collect_ops:
+                ops.append(("ins", i))
             w -= 1
         else:
             raise RuntimeError("corrupt traceback")
+    if collect_ops:
+        ops.reverse()
+        res.ops = ops
     return res
 
 

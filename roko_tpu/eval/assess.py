@@ -161,6 +161,9 @@ class ContigAssessment:
     #: only), so up to this many reported errors may be unknown-truth
     #: artefacts rather than polishing mistakes
     truth_n: int = 0
+    #: merged truth-space error rows (start, end, kind, count), only
+    #: when assessed with collect_errors (the --bed CLI path)
+    error_intervals: Optional[List[Tuple[int, int, str, int]]] = None
 
     @property
     def errors(self) -> int:
@@ -231,8 +234,14 @@ def assess_pair(
     truth_name: str = "truth",
     polished_name: str = "polished",
     try_revcomp: bool = True,
+    collect_errors: bool = False,
 ) -> ContigAssessment:
-    """Assess one polished contig against one truth contig."""
+    """Assess one polished contig against one truth contig.
+
+    ``collect_errors`` additionally fills ``error_intervals`` with
+    merged truth-space (start, end, kind, count) rows — only segments
+    whose (native-counted) result shows errors are re-walked through
+    the Python traceback, so the hot path stays in C++."""
     # normalise case: soft-masked (lowercase) regions are sequence, not
     # differences — anchoring already uppercases, the DP must agree
     truth = truth.upper()
@@ -256,6 +265,9 @@ def assess_pair(
         anchors=len(anchors),
         truth_n=truth.count(b"N"),
     )
+    rows: Optional[List[Tuple[int, int, str, int]]] = (
+        [] if collect_errors else None
+    )
     if not anchors:
         # no common unique k-mers: align whole-vs-whole (tiny contigs)
         # or give up and count the truth as fully missing (honest
@@ -263,33 +275,128 @@ def assess_pair(
         # _segment degrades to the worst case on MemoryError, so a
         # pathological pair can't abort the whole report.
         if len(truth) * 2 < 1 << 20 and len(seq) * 2 < 1 << 20:
-            _add(out, _segment(truth, seq))
+            _add(out, _segment(truth, seq, 0, rows))
         else:
             out.dele += len(truth)
             out.ins += len(seq)
+            if collect_errors:
+                rows.append((0, len(truth), "del", len(truth)))
+                if seq:
+                    rows.append((0, min(1, len(truth)), "ins", len(seq)))
+        out.error_intervals = rows
         return out
     # prefix + inter-anchor segments + suffix; anchor k-mers are exact
     # matches by construction
     t_prev, p_prev = 0, 0
     for ti, pi in anchors:
-        _add(out, _segment(truth[t_prev:ti], seq[p_prev:pi]))
+        _add(out, _segment(truth[t_prev:ti], seq[p_prev:pi], t_prev, rows))
         out.match += k
         t_prev, p_prev = ti + k, pi + k
-    _add(out, _segment(truth[t_prev:], seq[p_prev:]))
+    _add(out, _segment(truth[t_prev:], seq[p_prev:], t_prev, rows))
+    out.error_intervals = rows
     return out
 
 
-def _segment(a: bytes, b: bytes) -> AlignResult:
+# cells budget for the pure-Python position re-walk: far below the C++
+# MAX_CELLS because each cell is an interpreted loop iteration (~50M
+# cells ~ tens of seconds); bigger error-bearing segments fall back to
+# coarse per-kind span rows instead of exact positions
+_OPS_MAX_CELLS = 50_000_000
+
+
+def _segment(
+    a: bytes,
+    b: bytes,
+    t_offset: int = 0,
+    rows: Optional[List[Tuple[int, int, str, int]]] = None,
+) -> AlignResult:
     if not a and not b:
         return AlignResult(0, 0, 0, 0, False)
     pad = max(16, abs(len(a) - len(b)) + 16)
     try:
-        return align_with_band_growth(a, b, pad=pad)
+        res = align_with_band_growth(a, b, pad=pad)
     except MemoryError:
         # an anchor-free stretch too long for even the narrowest band
         # (multi-Mb structural divergence): degrade to the honest worst
         # case instead of aborting the whole report, and flag it capped
-        return AlignResult(0, 0, len(b), len(a), True)
+        res = AlignResult(0, 0, len(b), len(a), True)
+        if rows is not None:
+            _coarse_rows(rows, res, t_offset, len(a))
+        return res
+    if rows is not None and res.errors:
+        # re-walk only error-bearing segments through the Python oracle
+        # (identical tie-breaking -> identical path) to get exact
+        # positions; oversized segments degrade to coarse span rows
+        ops = _segment_ops(a, b, pad)
+        if ops is None:
+            _coarse_rows(rows, res, t_offset, len(a))
+        else:
+            rows.extend(
+                (s + t_offset, e + t_offset, kind, n)
+                for s, e, kind, n in merge_error_events(ops)
+            )
+    return res
+
+
+def _coarse_rows(
+    rows: List[Tuple[int, int, str, int]],
+    res: AlignResult,
+    t_offset: int,
+    la: int,
+) -> None:
+    """Per-kind whole-segment rows when exact positions are unavailable:
+    counts stay reconcilable with the report even without loci."""
+    span_end = t_offset + max(1, la)
+    if res.sub:
+        rows.append((t_offset, span_end, "sub", res.sub))
+    if res.dele:
+        rows.append((t_offset, span_end, "del", res.dele))
+    if res.ins:
+        rows.append((t_offset, min(t_offset + 1, span_end), "ins", res.ins))
+
+
+def _segment_ops(a: bytes, b: bytes, pad: int) -> Optional[List[Tuple[str, int]]]:
+    """Exact error events for a segment, or None when the interpreted DP
+    would exceed the cells budget (caller degrades to coarse rows)."""
+    from roko_tpu.eval.align import banded_align_py
+
+    pad = max(1, pad)
+    while True:
+        width = abs(len(b) - len(a)) + 2 * pad + 1
+        if (len(a) + 1) * width > _OPS_MAX_CELLS:
+            return None
+        try:
+            r = banded_align_py(a, b, pad, collect_ops=True)
+        except MemoryError:
+            return None
+        if not r.hit_band_edge or pad >= 4096:
+            return r.ops or []
+        pad *= 2
+
+
+def merge_error_events(
+    events: Optional[List[Tuple[str, int]]],
+) -> List[Tuple[int, int, str, int]]:
+    """Per-base (kind, truth_pos) events -> merged, sorted
+    (start, end, kind, count) rows. sub/del runs merge into half-open
+    intervals; insertions at the same point stack their count into one
+    zero-advance row reported as [pos, pos+1) (the truth base the extra
+    sequence precedes)."""
+    if not events:
+        return []
+    events = sorted(events, key=lambda e: (e[1], e[0]))
+    rows: List[Tuple[int, int, str, int]] = []
+    for kind, pos in events:
+        if rows:
+            s, e, pkind, n = rows[-1]
+            if pkind == kind and (
+                (kind in ("sub", "del") and pos == e)
+                or (kind == "ins" and pos == s)
+            ):
+                rows[-1] = (s, e if kind == "ins" else pos + 1, kind, n + 1)
+                continue
+        rows.append((pos, pos + 1, kind, 1))
+    return rows
 
 
 def _add(out: ContigAssessment, r: AlignResult) -> None:
@@ -339,7 +446,11 @@ def _pair_contigs(
 
 
 def assess_fastas(
-    truth: Dict[str, bytes], polished: Dict[str, bytes], *, k: int = K
+    truth: Dict[str, bytes],
+    polished: Dict[str, bytes],
+    *,
+    k: int = K,
+    collect_errors: bool = False,
 ) -> AssessResult:
     """Assess every truth contig against its best polished partner.
 
@@ -359,6 +470,11 @@ def assess_fastas(
                     truth_len=len(truth[tn]),
                     dele=len(truth[tn]),
                     truth_n=truth[tn].upper().count(b"N"),
+                    error_intervals=(
+                        [(0, len(truth[tn]), "del", len(truth[tn]))]
+                        if collect_errors
+                        else None
+                    ),
                 )
             )
         else:
@@ -369,6 +485,7 @@ def assess_fastas(
                     k=k,
                     truth_name=tn,
                     polished_name=pn,
+                    collect_errors=collect_errors,
                 )
             )
     return res
@@ -416,6 +533,26 @@ def format_report(res: AssessResult) -> str:
             "necessarily a polishing error)"
         )
     return "\n".join(lines)
+
+
+def write_bed(res: AssessResult, path: str) -> None:
+    """Truth-space error loci as BED: ``contig  start  end  kind  count``
+    (0-based half-open). ``sub``/``del`` rows span the affected truth
+    bases; an ``ins`` row marks the truth base the extra polished
+    sequence precedes ([pos, pos+1), count = inserted bases). Requires
+    an AssessResult produced with ``collect_errors=True``."""
+    with open(path, "w") as f:
+        for c in res.contigs:
+            if c.error_intervals is None:
+                raise ValueError(
+                    f"{c.truth_name}: no error intervals collected — "
+                    "assess with collect_errors=True"
+                )
+            for start, end, kind, count in c.error_intervals:
+                if kind == "ins" and end > c.truth_len:
+                    # trailing insertion: anchor the row to the last base
+                    start, end = max(0, c.truth_len - 1), c.truth_len
+                f.write(f"{c.truth_name}\t{start}\t{end}\t{kind}\t{count}\n")
 
 
 def write_json(res: AssessResult, path: str) -> None:

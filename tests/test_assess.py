@@ -222,6 +222,56 @@ def test_assess_unpaired_truth_counts_as_deleted():
     assert "b" in res.summary()["unpaired_truth_contigs"]
 
 
+def test_error_positions_recover_planted_sites():
+    """collect_errors pinpoints planted edits exactly on unambiguous
+    spaced mutations: truth-space positions and kinds match."""
+    truth = bytearray(rand_seq(random.Random(51), 4000))
+    # plant: sub at 500, delete truth[1500], insert before 2500
+    polished = bytearray(truth)
+    polished[500] = ord("A") if truth[500] != ord("A") else ord("C")
+    del polished[1500]
+    polished[2499:2499] = b"G" if truth[2499:2500] != b"G" else b"T"
+    c = assess_pair(bytes(truth), bytes(polished), collect_errors=True)
+    assert c.errors == 3
+    rows = c.error_intervals
+    kinds = {(kind, start) for start, _, kind, _ in rows}
+    assert ("sub", 500) in kinds
+    assert ("del", 1500) in kinds
+    assert any(kind == "ins" and abs(start - 2499) <= 1 for start, _, kind, _ in rows)
+
+
+def test_error_intervals_merge_runs():
+    from roko_tpu.eval.assess import merge_error_events
+
+    rows = merge_error_events(
+        [("del", 10), ("del", 11), ("del", 12), ("sub", 20), ("sub", 22),
+         ("ins", 30), ("ins", 30)]
+    )
+    assert (10, 13, "del", 3) in rows
+    assert (20, 21, "sub", 1) in rows and (22, 23, "sub", 1) in rows
+    assert (30, 31, "ins", 2) in rows
+
+
+def test_cli_assess_bed(tmp_path, capsys):
+    from roko_tpu.cli import main
+    from roko_tpu.io.fasta import write_fasta
+
+    rng = random.Random(53)
+    truth = rand_seq(rng, 3_000).decode()
+    polished = mutate(rng, truth.encode(), 2, 1, 1).decode()
+    tf, pf = tmp_path / "t.fasta", tmp_path / "p.fasta"
+    write_fasta(str(tf), [("ctg", truth)])
+    write_fasta(str(pf), [("ctg", polished)])
+    bed = tmp_path / "err.bed"
+    rc = main(["assess", str(pf), str(tf), "--bed", str(bed)])
+    assert rc == 0
+    capsys.readouterr()
+    lines = bed.read_text().strip().splitlines()
+    assert len(lines) == 4  # 2 sub + 1 ins + 1 del, all spaced
+    kinds = sorted(l.split("\t")[3] for l in lines)
+    assert kinds == ["del", "ins", "sub", "sub"]
+
+
 def test_report_formats(tmp_path):
     rng = random.Random(21)
     truth = rand_seq(rng, 6_000)
