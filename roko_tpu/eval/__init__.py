@@ -8,6 +8,9 @@ from roko_tpu.eval.assess import (
     ContigAssessment,
     assess_fastas,
     assess_pair,
+    format_report,
+    write_bed,
+    write_json,
 )
 
 __all__ = [
@@ -17,4 +20,7 @@ __all__ = [
     "assess_fastas",
     "assess_pair",
     "banded_align",
+    "format_report",
+    "write_bed",
+    "write_json",
 ]

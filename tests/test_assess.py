@@ -272,6 +272,17 @@ def test_cli_assess_bed(tmp_path, capsys):
     assert kinds == ["del", "ins", "sub", "sub"]
 
 
+def test_write_bed_requires_collected_intervals(tmp_path):
+    from roko_tpu.eval import write_bed
+    from roko_tpu.eval.assess import AssessResult
+
+    res = AssessResult(
+        contigs=[assess_pair(b"ACGT" * 200, b"ACGT" * 200)]  # no collect
+    )
+    with pytest.raises(ValueError, match="collect_errors"):
+        write_bed(res, str(tmp_path / "x.bed"))
+
+
 def test_report_formats(tmp_path):
     rng = random.Random(21)
     truth = rand_seq(rng, 6_000)
