@@ -8,13 +8,16 @@ from roko_tpu import benchmark as B
 from roko_tpu.config import ModelConfig
 
 
-def test_bench_json_contract(capsys, monkeypatch):
+def test_bench_json_contract(capsys, monkeypatch, tmp_path):
     # keep the contract check cheap and deterministic even if a future
     # conftest runs this suite against a live TPU backend
     monkeypatch.setenv("ROKO_BENCH_TRAIN_BUDGET", "0")
-    B.main(["--batch", "8"])
+    out_file = tmp_path / "bench.json"
+    B.main(["--batch", "8", "--out", str(out_file)])
     line = capsys.readouterr().out.strip().splitlines()[-1]
     result = json.loads(line)
+    # --out writes the same object to disk
+    assert json.loads(out_file.read_text()) == result
     assert result["metric"] == "polished_bases_per_sec_per_chip"
     assert result["unit"] == "bases/s"
     assert result["value"] > 0 and result["vs_baseline"] > 0
