@@ -80,11 +80,16 @@ def main() -> int:
     pol = {n: s.encode() for n, s in read_fasta(polished)}
 
     draft_res = assess_fastas(truth, draft)
-    pol_res = assess_fastas(truth, pol)
+    pol_res = assess_fastas(truth, pol, collect_errors=True)
     print("\n-- draft vs truth (before polishing)")
     print(format_report(draft_res))
     print("\n-- polished vs truth (after)")
     print(format_report(pol_res))
+    from roko_tpu.eval.assess import write_bed
+
+    bed = os.path.join(wd, "residual_errors.bed")
+    write_bed(pol_res, bed)
+    print(f"residual error loci: {bed}")
     better = pol_res.error_rate < draft_res.error_rate
     print(
         f"\npolishing {'reduced' if better else 'did NOT reduce'} the error "
