@@ -44,6 +44,22 @@ def test_model_flops_follow_window_geometry():
     assert train > base  # fwd+bwd counted
 
 
+def test_perf_probe_tool_parses():
+    """tools/perf_probe.py must at least import and parse args — it can
+    only RUN on live hardware, so guard it against bit-rot here."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "tools/perf_probe.py", "--help"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=60,
+    )
+    assert r.returncode == 0 and "--quick" in r.stdout
+
+
 def test_train_suite_budget_reports_skips():
     out = B.run_train_suite(batch=2, budget_s=0.0)
     skipped = [v for v in out.values() if isinstance(v, dict) and "error" in v]
