@@ -407,30 +407,16 @@ def run_features_suite(
     return out
 
 
-def main(argv=None) -> None:
-    import argparse
-
-    from roko_tpu import constants as C
-
+def _measure(args) -> Dict[str, Any]:
+    """Run the actual measurement in THIS process and return the driver
+    result object. Assumes the JAX backend in this process is usable —
+    callers that cannot assume that (the driver path) go through the
+    orchestrated ``main`` below, which probes and falls back instead of
+    letting a sick backend turn the round's artifact into a traceback
+    (VERDICT r3: BENCH_r03.json rc=1, parsed null)."""
     import os
 
-    ap = argparse.ArgumentParser(prog="roko-tpu bench")
-    ap.add_argument("--train", action="store_true", help="also time training steps")
-    ap.add_argument(
-        "--features",
-        action="store_true",
-        help="also time host-side feature extraction (native vs Python)",
-    )
-    ap.add_argument(
-        "--batch",
-        type=int,
-        default=None,
-        help=f"exact batch to bench (default: sweep {SWEEP_BATCHES} on TPU)",
-    )
-    ap.add_argument(
-        "--out", default=None, help="write the full result dict to this JSON file"
-    )
-    args = ap.parse_args(argv)
+    from roko_tpu import constants as C
 
     # parse the env knob BEFORE any measurement so a typo can't discard
     # minutes of completed TPU work on a late ValueError
@@ -463,18 +449,232 @@ def main(argv=None) -> None:
         "jax": jax.__version__,
     }
     windows_per_sec = detail["windows_per_sec"]
-    result = {
+    return {
         "metric": "polished_bases_per_sec_per_chip",
         "value": round(windows_per_sec * C.WINDOW_STRIDE, 1),
         "unit": "bases/s",
         "vs_baseline": round(windows_per_sec / ref_windows_per_sec, 2),
         "detail": detail,
     }
-    if args.out:
-        with open(args.out, "w") as f:
+
+
+def _emit(result: Dict[str, Any], out_path) -> None:
+    if out_path:
+        with open(out_path, "w") as f:
             json.dump(result, f, indent=2)
             f.write("\n")
     print(json.dumps(result))
+
+
+def _wait_no_kill(proc, budget_s: float):
+    """Wait up to ``budget_s`` for ``proc``; return its rc, or None on
+    timeout. NEVER kills: a TPU client killed mid-claim/compile wedges
+    the loopback relay for the rest of the session (observed rounds 2
+    and 3) — on timeout the child is abandoned to finish on its own."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        time.sleep(2.0)
+    # final poll: the child may have finished during the last sleep —
+    # misclassifying that as a hang would discard a completed TPU run
+    return proc.poll()
+
+
+def _tail(path, n: int = 2000) -> str:
+    try:
+        with open(path, "r", errors="replace") as f:
+            return f.read()[-n:]
+    except OSError:
+        return ""
+
+
+def _spawn_logged(cmd, budget_s: float, **popen_kw):
+    """Popen ``cmd`` with stdout+stderr to a temp log, wait (never kill)
+    up to ``budget_s``. Returns (rc_or_None, log_tail). The log file is
+    removed unless the child was abandoned (its tail may still be
+    wanted for post-mortem while it runs)."""
+    import os
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+        "w+", suffix=".log", delete=False
+    ) as logf:
+        proc = subprocess.Popen(
+            cmd, stdout=logf, stderr=subprocess.STDOUT, **popen_kw
+        )
+        rc = _wait_no_kill(proc, budget_s)
+        out = _tail(logf.name)
+    if rc is not None:
+        try:
+            os.unlink(logf.name)
+        except OSError:
+            pass
+    return rc, out
+
+
+def _probe_backend(timeout_s: float, log) -> tuple:
+    """Can a fresh process initialize the JAX backend at all?  Runs
+    ``jax.devices()`` in a subprocess so a wedged TPU relay hangs the
+    probe, not the artifact path. Returns (ok, reason)."""
+    import sys
+
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print('PROBE_OK', d[0].platform, getattr(d[0], 'device_kind', '?'),"
+        " flush=True)\n"
+    )
+    rc, out = _spawn_logged([sys.executable, "-c", code], timeout_s)
+    if rc is None:
+        return False, (
+            f"backend probe still hung after {timeout_s:.0f}s "
+            f"(relay wedged?); probe abandoned, not killed. tail: {out[-300:]}"
+        )
+    if rc != 0 or "PROBE_OK" not in out:
+        return False, f"backend probe rc={rc}: {out[-400:]}"
+    log(f"[bench] backend probe ok: {out.strip().splitlines()[-1]}")
+    return True, ""
+
+
+def _run_child_bench(args, budget_s: float, log):
+    """Run the full measurement in a child process (same env, live
+    backend) with a wall-clock budget, so a mid-suite relay death can at
+    worst cost the budget — never the artifact. Returns the child's
+    result dict, or None."""
+    import os
+    import sys
+    import tempfile
+
+    out_json = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
+    cmd = [sys.executable, "-m", "roko_tpu.benchmark", "--in-process"]
+    cmd += ["--out", out_json]
+    if args.train:
+        cmd.append("--train")
+    if args.features:
+        cmd.append("--features")
+    if args.batch is not None:
+        cmd += ["--batch", str(args.batch)]
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rc, out = _spawn_logged(cmd, budget_s, cwd=repo_root)
+    if rc == 0:
+        try:
+            with open(out_json) as f:
+                result = json.load(f)
+            os.unlink(out_json)
+            return result
+        except (OSError, ValueError) as e:
+            log(f"[bench] child rc=0 but result unreadable: {e}")
+            return None
+    log(
+        f"[bench] TPU child {'timed out (abandoned)' if rc is None else f'rc={rc}'};"
+        f" log tail:\n{out[-1500:]}"
+    )
+    return None
+
+
+def _force_cpu_backend() -> None:
+    """Point THIS process (and any children) at the CPU backend, even if
+    a sitecustomize already imported jax and registered the TPU plugin."""
+    import os
+
+    from roko_tpu.cli import _honor_jax_platforms_env
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    _honor_jax_platforms_env()
+
+
+def main(argv=None) -> None:
+    import argparse
+    import os
+    import sys
+
+    ap = argparse.ArgumentParser(prog="roko-tpu bench")
+    ap.add_argument("--train", action="store_true", help="also time training steps")
+    ap.add_argument(
+        "--features",
+        action="store_true",
+        help="also time host-side feature extraction (native vs Python)",
+    )
+    ap.add_argument(
+        "--batch",
+        type=int,
+        default=None,
+        help=f"exact batch to bench (default: sweep {SWEEP_BATCHES} on TPU)",
+    )
+    ap.add_argument(
+        "--out", default=None, help="write the full result dict to this JSON file"
+    )
+    ap.add_argument(
+        "--in-process",
+        action="store_true",
+        help="measure in this process (no probe/fallback orchestration); "
+        "the orchestrated default exists because the driver artifact must "
+        "parse even when the TPU relay is wedged",
+    )
+    args = ap.parse_args(argv)
+
+    log = lambda msg: print(msg, file=sys.stderr, flush=True)  # noqa: E731
+
+    # Only an explicit CPU platform (tests, conftest) runs un-orchestrated:
+    # anywhere an accelerator could be claimed — the driver's
+    # JAX_PLATFORMS=axon tunnel, or a TPU VM where jax autodetects the
+    # chip with no env set — the sick-backend probe/fallback must wrap
+    # the measurement, because a wedged backend HANGS in-process init.
+    if args.in_process or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        _emit(_measure(args), args.out)
+        return
+
+    try:
+        try:
+            probe_timeout = float(
+                os.environ.get("ROKO_BENCH_PROBE_TIMEOUT", "300")
+            )
+        except ValueError:
+            probe_timeout = 300.0
+        try:
+            tpu_budget = float(os.environ.get("ROKO_BENCH_TPU_BUDGET", "1380"))
+        except ValueError:
+            tpu_budget = 1380.0
+
+        t0 = time.monotonic()
+        ok, why = _probe_backend(probe_timeout, log)
+        if ok:
+            result = _run_child_bench(
+                args, max(60.0, tpu_budget - (time.monotonic() - t0)), log
+            )
+            if result is not None:
+                _emit(result, args.out)
+                return
+            why = (
+                "backend probe ok but the TPU bench child failed or "
+                "exceeded its budget (see stderr tail above)"
+            )
+        # Fallback of record: a CPU run that still produces every field,
+        # honestly labelled. Reduced batch keeps it fast; env.backend
+        # says "cpu" and tpu_error says why, so the artifact can never
+        # masquerade as a chip measurement.
+        log(f"[bench] falling back to CPU: {why}")
+        _force_cpu_backend()
+        if args.batch is None:
+            args.batch = 64
+        result = _measure(args)
+        result["detail"].setdefault("env", {})["tpu_error"] = why[:600]
+        _emit(result, args.out)
+    except Exception as e:  # absolute last resort: the artifact must parse
+        _emit(
+            {
+                "metric": "polished_bases_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "bases/s",
+                "vs_baseline": 0.0,
+                "detail": {"fatal": f"{type(e).__name__}: {e}"[:600]},
+            },
+            args.out,
+        )
 
 
 if __name__ == "__main__":

@@ -100,6 +100,80 @@ def test_features_suite_times_both_backends():
     assert "windows_per_sec" in out["native"]
 
 
+def test_orchestrated_main_falls_back_to_cpu_on_dead_backend(
+    capsys, monkeypatch
+):
+    """The driver path (VERDICT r3 task 1): with a TPU-ish env and a
+    backend probe that reports the relay wedged, main() must still emit
+    one parse-able JSON line — from a CPU run honestly labelled with
+    env.backend=cpu and a tpu_error reason — never a traceback."""
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # driver-like env
+    # register with monkeypatch so the fallback's pop() is undone
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
+    monkeypatch.setenv("ROKO_BENCH_TRAIN_BUDGET", "0")
+    monkeypatch.setattr(
+        B, "_probe_backend", lambda t, log: (False, "simulated wedge")
+    )
+    # the real _measure is exercised by test_bench_json_contract; here a
+    # canned result keeps the orchestration-wiring assertion fast. It
+    # must still observe the forced-CPU env the fallback promises.
+    import os
+
+    def fake_measure(args):
+        assert os.environ["JAX_PLATFORMS"] == "cpu"
+        assert args.batch == 8  # explicit batch preserved by fallback
+        return {
+            "metric": "polished_bases_per_sec_per_chip",
+            "value": 5.0,
+            "unit": "bases/s",
+            "vs_baseline": 1.0,
+            "detail": {"env": {"backend": "cpu"}},
+        }
+
+    monkeypatch.setattr(B, "_measure", fake_measure)
+    B.main(["--batch", "8"])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["value"] > 0
+    env = result["detail"]["env"]
+    assert env["backend"] == "cpu"
+    assert "simulated wedge" in env["tpu_error"]
+
+
+def test_orchestrated_main_uses_child_result_when_probe_ok(
+    capsys, monkeypatch
+):
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")  # driver-like env
+    child = {
+        "metric": "polished_bases_per_sec_per_chip",
+        "value": 123.0,
+        "unit": "bases/s",
+        "vs_baseline": 9.0,
+        "detail": {"env": {"backend": "tpu"}},
+    }
+    monkeypatch.setattr(B, "_probe_backend", lambda t, log: (True, ""))
+    monkeypatch.setattr(B, "_run_child_bench", lambda a, b, log: child)
+    B.main([])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert json.loads(line) == child
+
+
+def test_wait_no_kill_abandons_without_killing():
+    import subprocess
+    import sys
+    import time as _time
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(4)"],
+        stdout=subprocess.DEVNULL,
+    )
+    t0 = _time.monotonic()
+    assert B._wait_no_kill(proc, 0.05) is None  # timed out, not killed
+    assert proc.poll() is None  # still running — never killed
+    assert proc.wait(timeout=30) == 0  # dies on its own, cleanly
+    assert _time.monotonic() - t0 < 30
+
+
 def test_inference_suite_raises_when_all_paths_fail(monkeypatch):
     def boom(cfg, b, iters=1):
         raise ValueError("kernel exploded")
