@@ -266,7 +266,15 @@ def cmd_polish(args: argparse.Namespace) -> int:
         )
         print(f"wrote polished contigs to {args.out}")
     if args.truth:
-        _print_assess(args.out, args.truth)
+        # polish_to_fasta writes args.out only from process 0 (and syncs
+        # before returning): on a pod, only that process can read it back
+        # — elsewhere the file may not exist (non-shared filesystem) and
+        # the report would print once per process even when it does
+        # (ADVICE r3).
+        import jax
+
+        if jax.process_index() == 0:
+            _print_assess(args.out, args.truth)
     return 0
 
 

@@ -369,7 +369,9 @@ def _segment_ops(a: bytes, b: bytes, pad: int) -> Optional[List[Tuple[str, int]]
             r = banded_align_py(a, b, pad, collect_ops=True)
         except MemoryError:
             return None
-        if not r.hit_band_edge or pad >= 4096:
+        # same Ukkonen stop rule as align_with_band_growth: errors <= pad
+        # proves in-band optimality; edge contact alone does not
+        if r.errors <= pad or pad >= 4096:
             return r.ops or []
         pad *= 2
 

@@ -15,20 +15,20 @@ constexpr int64_t kInf = std::numeric_limits<int64_t>::max() / 4;
 
 }  // namespace
 
-bool BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
-                 int64_t pad, int64_t max_cells, AlignCounts* counts) {
+AlignStatus BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
+                        int64_t pad, int64_t max_cells, AlignCounts* counts) {
   // Degenerate segments: one side empty is pure gap.
   if (la == 0 || lb == 0) {
     counts->ins += lb;
     counts->del_ += la;
     counts->hit_band_edge = false;
-    return true;
+    return AlignStatus::kOk;
   }
   const int64_t dlo = std::min<int64_t>(0, lb - la) - pad;
   const int64_t dhi = std::max<int64_t>(0, lb - la) + pad;
   const int64_t width = dhi - dlo + 1;
   const int64_t cells = (la + 1) * width;
-  if (cells > max_cells) return false;
+  if (cells > max_cells) return AlignStatus::kCellsCap;
 
   // dist[w] holds row i's costs for diagonal d = dlo + w (j = i + d).
   std::vector<int64_t> prev(width, kInf), cur(width, kInf);
@@ -74,7 +74,8 @@ bool BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
   }
 
   const int64_t end_w = lb - la - dlo;
-  if (end_w < 0 || end_w >= width || prev[end_w] >= kInf) return false;
+  if (end_w < 0 || end_w >= width || prev[end_w] >= kInf)
+    return AlignStatus::kUnreachableEnd;
 
   // Walk back from (la, lb), counting ops and noting band-edge contact.
   AlignCounts c;
@@ -98,7 +99,7 @@ bool BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
       ++c.ins;
       --w;
     } else {
-      return false;  // kNone before the origin: corrupt band
+      return AlignStatus::kCorruptTraceback;  // kNone before the origin
     }
   }
   counts->match += c.match;
@@ -106,7 +107,7 @@ bool BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
   counts->ins += c.ins;
   counts->del_ += c.del_;
   counts->hit_band_edge = c.hit_band_edge;
-  return true;
+  return AlignStatus::kOk;
 }
 
 }  // namespace roko

@@ -27,12 +27,24 @@ struct AlignCounts {
   bool hit_band_edge = false;  // optimal path touched the band limit
 };
 
+// Distinct failure modes so the binding can map the resource cap to a
+// retryable MemoryError while genuine aligner bugs surface loudly
+// instead of degrading into plausible-looking worst-case counts
+// (ADVICE r3). kUnreachableEnd / kCorruptTraceback cannot happen for
+// valid inputs (the end diagonal lies inside the band by construction
+// and the band is contiguous) — they indicate an internal bug.
+enum class AlignStatus {
+  kOk = 0,
+  kCellsCap = 1,         // (la+1) * band_width > max_cells
+  kUnreachableEnd = 2,   // end cell not reached: internal bug
+  kCorruptTraceback = 3  // kNone move before the origin: internal bug
+};
+
 // Global alignment of a[0:la) vs b[0:lb) with a band of diagonals
 // j - i in [min(0, lb-la) - pad, max(0, lb-la) + pad].
-// Returns false when the DP working set would exceed max_cells
-// (traceback is one byte per cell); counts are untouched then.
-bool BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
-                 int64_t pad, int64_t max_cells, AlignCounts* counts);
+// On kCellsCap the counts are untouched.
+AlignStatus BandedAlign(const char* a, int64_t la, const char* b, int64_t lb,
+                        int64_t pad, int64_t max_cells, AlignCounts* counts);
 
 }  // namespace roko
 

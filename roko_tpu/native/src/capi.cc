@@ -71,15 +71,27 @@ int roko_extract_windows(const char* bam_path, const char* contig,
 
 // Banded global alignment of a vs b (roko_tpu/eval/assess.py segment
 // hot loop). out8 receives [match, sub, ins, del, hit_band_edge, 0, 0,
-// 0]. Returns 0 on success, 3 when the band x length working set
-// exceeds max_cells (caller shrinks the segment or widens in steps).
+// 0]. Returns 0 on success; 3 ONLY when the band x length working set
+// exceeds max_cells (retryable: caller shrinks the segment or widens
+// in steps); 1 for internal aligner bugs, which the binding raises as
+// RuntimeError rather than letting the caller degrade them into
+// plausible worst-case counts (ADVICE r3).
 int roko_align_counts(const char* a, int64_t la, const char* b, int64_t lb,
                       int64_t pad, int64_t max_cells, int64_t* out8) {
   try {
     roko::AlignCounts c;
-    if (!roko::BandedAlign(a, la, b, lb, pad, max_cells, &c)) {
-      g_last_error = "alignment working set exceeds max_cells";
-      return 3;
+    switch (roko::BandedAlign(a, la, b, lb, pad, max_cells, &c)) {
+      case roko::AlignStatus::kOk:
+        break;
+      case roko::AlignStatus::kCellsCap:
+        g_last_error = "alignment working set exceeds max_cells";
+        return 3;
+      case roko::AlignStatus::kUnreachableEnd:
+        g_last_error = "internal aligner error: end cell unreachable";
+        return 1;
+      case roko::AlignStatus::kCorruptTraceback:
+        g_last_error = "internal aligner error: corrupt traceback";
+        return 1;
     }
     out8[0] = c.match;
     out8[1] = c.sub;

@@ -105,6 +105,34 @@ def _full_edit_distance(a: bytes, b: bytes) -> int:
     return prev[-1]
 
 
+def test_band_growth_is_exact_at_any_starting_pad():
+    """ADVICE r3 (medium): edge contact is not a sufficient optimality
+    condition — fuzzing with small starting pads produced no-contact
+    results 1-2 above the true edit distance. The Ukkonen stop rule
+    (grow until errors <= pad) must return the exact distance from ANY
+    starting pad, and never flag an uncapped result band-capped."""
+    from roko_tpu.eval.align import align_with_band_growth
+
+    rng = random.Random(7)
+    for trial in range(300):
+        a = rand_seq(rng, rng.randrange(18, 35))
+        b = bytearray(a)
+        # mutate heavily so small pads are genuinely insufficient
+        for _ in range(rng.randrange(0, 10)):
+            kind = rng.randrange(3)
+            if kind == 0 and b:
+                b[rng.randrange(len(b))] = rng.choice(b"ACGT")
+            elif kind == 1:
+                b.insert(rng.randrange(len(b) + 1), rng.choice(b"ACGT"))
+            elif kind == 2 and b:
+                del b[rng.randrange(len(b))]
+        b = bytes(b)
+        pad = rng.randrange(1, 9)
+        r = align_with_band_growth(a, b, pad=pad)
+        assert r.errors == _full_edit_distance(a, b), (a, b, pad, trial)
+        assert not r.hit_band_edge
+
+
 def test_banded_total_cost_equals_full_dp():
     """With a band covering the whole matrix, sub+ins+del must equal the
     unbanded Levenshtein distance on arbitrary (even unrelated) pairs."""
