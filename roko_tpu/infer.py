@@ -132,43 +132,88 @@ class VoteBoard:
                 "or reduce region overlap."
             )
 
+    # A bincount over a span wider than this (x NUM_CLASSES int64
+    # counts) would allocate more than it saves; batches whose windows
+    # span a wider flat-slot range scatter with np.add.at instead.
+    # 2M slots = a 500 kb contiguous stretch (~80 MB temporary). With
+    # iter_inference_windows feeding batches in genome order a batch
+    # spans ~batch_size x stride bases (~60k slots), so this cap is a
+    # safety net for exotic feeds, not the common path.
+    _BINCOUNT_SPAN_CAP = 2_000_000
+
+    def _scatter(self, board: np.ndarray, flat: np.ndarray,
+                 preds: np.ndarray, contig: str) -> None:
+        """Fused scatter-add of votes into ``board[flat, preds]``.
+
+        ``np.bincount`` over the touched span beats ``np.add.at`` by
+        ~20x (the r4 host-path profile measured the per-row add.at loop
+        near the device rate); per-batch counts fit far inside uint16
+        (<= batch_size votes per slot), and the post-add saturation
+        check runs every batch, so a slot is caught crossing SAT_LIMIT
+        before the +536 headroom to the uint16 wrap can be consumed."""
+        lo, hi = int(flat.min()), int(flat.max()) + 1
+        if hi - lo > self._BINCOUNT_SPAN_CAP:
+            np.add.at(board, (flat, preds), 1)
+            self._check_saturation(int(board[flat, preds].max()), contig)
+            return
+        comb = (flat.astype(np.int64) - lo) * C.NUM_CLASSES + preds
+        counts = np.bincount(
+            comb.ravel(), minlength=(hi - lo) * C.NUM_CLASSES
+        ).reshape(-1, C.NUM_CLASSES)
+        region = board[lo:hi]
+        region += counts.astype(np.uint16)
+        self._check_saturation(int(region.max()), contig)
+
     def add(
         self, contigs: List[str], positions: np.ndarray, preds: np.ndarray
     ) -> None:
-        """positions int64[B,90,2] (pos, ins); preds int[B,90]."""
+        """positions int64[B,90,2] (pos, ins); preds int[B,90].
+
+        Rows are grouped by contig (genome-scale batches are almost
+        always single-contig) and each group lands in one fused
+        scatter-add instead of a per-row ``np.add.at`` loop."""
+        groups: Dict[str, List[int]] = {}
         for i, name in enumerate(contigs):
-            board = self._board(name)
-            if self._is_sparse(name):
-                ins_mask = positions[i, :, 1] != 0
-                base = ~ins_mask
-                np.add.at(
-                    board, (positions[i, base, 0], preds[i][base]), 1
+            groups.setdefault(name, []).append(i)
+        for name, rows in groups.items():
+            # <=512 rows per scatter call: a slot receives at most one
+            # vote per row, so per-call increments stay below the 536
+            # headroom between SAT_LIMIT and the uint16 wrap — the
+            # post-scatter check therefore always fires before a wrap,
+            # whatever batch size the caller uses.
+            for chunk in range(0, len(rows), 512):
+                self._add_rows(
+                    name, positions, preds, rows[chunk : chunk + 512]
                 )
-                if base.any():
-                    self._check_saturation(
-                        int(board[positions[i, base, 0], preds[i][base]].max()),
-                        name,
-                    )
-                ins_map = self._ins[name]
-                flat = (
-                    positions[i, ins_mask, 0] * _SLOTS
-                    + positions[i, ins_mask, 1]
-                )
-                for slot, p in zip(flat.tolist(), preds[i][ins_mask].tolist()):
-                    counts = ins_map.get(slot)
-                    if counts is None:
-                        counts = ins_map[slot] = np.zeros(
-                            C.NUM_CLASSES, np.uint16
-                        )
-                    if counts[p] >= self.SAT_LIMIT:
-                        self._check_saturation(int(counts[p]), name)
-                    counts[p] += 1
-            else:
-                flat = positions[i, :, 0] * _SLOTS + positions[i, :, 1]
-                np.add.at(board, (flat, preds[i]), 1)
-                self._check_saturation(
-                    int(board[flat, preds[i]].max()), name
-                )
+
+    def _add_rows(
+        self,
+        name: str,
+        positions: np.ndarray,
+        preds: np.ndarray,
+        rows: List[int],
+    ) -> None:
+        board = self._board(name)
+        idx = np.asarray(rows)
+        pos = positions[idx]
+        prd = np.asarray(preds)[idx]
+        if self._is_sparse(name):
+            ins_mask = pos[:, :, 1] != 0
+            base = ~ins_mask
+            if base.any():
+                self._scatter(board, pos[:, :, 0][base], prd[base], name)
+            ins_map = self._ins[name]
+            flat = pos[:, :, 0][ins_mask] * _SLOTS + pos[:, :, 1][ins_mask]
+            for slot, p in zip(flat.tolist(), prd[ins_mask].tolist()):
+                counts = ins_map.get(slot)
+                if counts is None:
+                    counts = ins_map[slot] = np.zeros(C.NUM_CLASSES, np.uint16)
+                if counts[p] >= self.SAT_LIMIT:
+                    self._check_saturation(int(counts[p]), name)
+                counts[p] += 1
+        else:
+            flat = pos[:, :, 0] * _SLOTS + pos[:, :, 1]
+            self._scatter(board, flat.ravel(), prd.ravel(), name)
 
     def _covered_and_counts(self, contig: str):
         """(covered flat slot ids sorted by (pos, ins), vote counts
@@ -282,6 +327,13 @@ def run_inference(
     t0 = time.perf_counter()
     n_windows = 0
     with device_trace(trace_dir):
+        # one-deep software pipeline: dispatch batch k+1's predict
+        # (async under jax) BEFORE blocking on batch k's device->host
+        # fetch and voting, so host-side vote accumulation overlaps
+        # device compute instead of serialising with it. The
+        # "predict+d2h" span therefore measures time actually BLOCKED
+        # on the device, not raw step time.
+        pending = None  # (names, positions, preds_future, n)
         for names, positions, x, n in prefetch_to_device(
             iter_inference_windows(
                 data_path, batch_size, contig_filter=contig_filter
@@ -289,11 +341,22 @@ def run_inference(
             prefetch,
             place,
         ):
+            fut = predict(params, x)
+            if pending is not None:
+                pnames, ppos, pfut, pn = pending
+                with timer("predict+d2h"):
+                    preds = np.asarray(jax.device_get(pfut))[:pn]
+                with timer("vote"):
+                    board.add(pnames, ppos, preds)
+                n_windows += pn
+            pending = (names, positions, fut, n)
+        if pending is not None:
+            pnames, ppos, pfut, pn = pending
             with timer("predict+d2h"):
-                preds = np.asarray(jax.device_get(predict(params, x)))[:n]
+                preds = np.asarray(jax.device_get(pfut))[:pn]
             with timer("vote"):
-                board.add(names, positions, preds)
-            n_windows += n
+                board.add(pnames, ppos, preds)
+            n_windows += pn
     dt = time.perf_counter() - t0
     log(
         f"inference: {n_windows} windows in {dt:.1f}s "

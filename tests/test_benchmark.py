@@ -13,7 +13,7 @@ def test_bench_json_contract(capsys, monkeypatch, tmp_path):
     # conftest runs this suite against a live TPU backend
     monkeypatch.setenv("ROKO_BENCH_TRAIN_BUDGET", "0")
     out_file = tmp_path / "bench.json"
-    B.main(["--batch", "8", "--out", str(out_file)])
+    B.main(["--batch", "8", "--out", str(out_file), "--e2e-draft", "0"])
     line = capsys.readouterr().out.strip().splitlines()[-1]
     result = json.loads(line)
     # --out writes the same object to disk
@@ -89,6 +89,19 @@ def test_inference_suite_no_sweep_off_tpu(monkeypatch):
     detail = B.run_inference_suite()
     assert set(detail["batch_sweep"]) == {str(B.BATCH)}
     assert "pallas_windows_per_sec" not in detail
+
+
+def test_e2e_suite_reports_pipeline_breakdown():
+    """run_e2e_suite drives the REAL features->inference->stitch path
+    on a tiny synthetic project and must report every stage plus the
+    rates the driver artifact's end_to_end block promises."""
+    out = B.run_e2e_suite(draft_len=20_000, coverage=8)
+    assert out["windows"] > 0 and out["polished_contigs"] == 1
+    for key in ("sim_s", "features_s", "inference_s"):
+        assert out["stages"][key] > 0
+    assert out["inference_windows_per_sec"] > 0
+    assert out["pipeline_bases_per_sec"] > 0
+    assert any("predict" in ln for ln in out["stage_breakdown"])
 
 
 def test_features_suite_times_both_backends():
