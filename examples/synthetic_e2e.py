@@ -32,6 +32,14 @@ def main() -> int:
     ap.add_argument("--epochs", type=int, default=25)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--dp", type=int, default=-1)
+    ap.add_argument(
+        "--error-model",
+        choices=("uniform", "homopolymer"),
+        default="uniform",
+        help="homopolymer: run-rich truth genome with indels "
+        "concentrated in homopolymer runs (nanopore's dominant error "
+        "class) — the adversarial regime for consensus polishing",
+    )
     args = ap.parse_args()
 
     from roko_tpu.cli import _honor_jax_platforms_env, main as cli
@@ -42,8 +50,11 @@ def main() -> int:
     from roko_tpu.sim import build_synthetic_project
 
     wd = args.workdir
-    print(f"== building synthetic project in {wd}")
-    paths = build_synthetic_project(wd, genome_len=args.genome_len)
+    hp = {}
+    if args.error_model == "homopolymer":
+        hp = {"hp_indel_bias": 3.0, "hp_extend": 0.45}
+    print(f"== building synthetic project in {wd} ({args.error_model} errors)")
+    paths = build_synthetic_project(wd, genome_len=args.genome_len, **hp)
 
     print("== stage 1: features (training mode, with truth labels)")
     train_h5 = os.path.join(wd, "train.hdf5")

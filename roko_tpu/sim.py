@@ -28,9 +28,53 @@ from roko_tpu.io.bam import BamRecord
 
 BASES = "ACGT"
 
+# Effective per-position indel rates are capped here no matter how long
+# the homopolymer run: beyond ~0.4 a simulated read decays into gap
+# soup that no longer resembles a sequencing error profile.
+_HP_RATE_CAP = 0.4
+
 
 def random_seq(rng: random.Random, n: int) -> str:
     return "".join(rng.choice(BASES) for _ in range(n))
+
+
+def random_genome(rng: random.Random, n: int, hp_extend: float = 0.0) -> str:
+    """Random genome with geometric homopolymer run lengths: each base
+    repeats with probability ``hp_extend`` per extra copy (0 = i.i.d.
+    bases, which almost never produces the >=5-base runs real genomes
+    carry). ``hp_extend=0.45`` gives mean run ~1.8 with runs of 8+
+    appearing at genome scale — the substrate the homopolymer error
+    model (``hp_indel_bias``) needs to be adversarial."""
+    if hp_extend <= 0.0:
+        return random_seq(rng, n)
+    out: List[str] = []
+    while len(out) < n:
+        b = rng.choice(BASES)
+        if out and out[-1] == b:  # runs are shaped by hp_extend alone
+            continue
+        out.append(b)
+        while len(out) < n and rng.random() < hp_extend:
+            out.append(b)
+    return "".join(out)
+
+
+def _run_lengths(seq: str) -> List[int]:
+    """run[i] = length of the homopolymer run containing position i."""
+    n = len(seq)
+    out = [1] * n
+    i = 0
+    while i < n:
+        j = i
+        while j < n and seq[j] == seq[i]:
+            j += 1
+        for k in range(i, j):
+            out[k] = j - i
+        i = j
+    return out
+
+
+def _hp_factor(run_len: int, bias: float) -> float:
+    return 1.0 + bias * (run_len - 1)
 
 
 def mutate(
@@ -112,11 +156,21 @@ def simulate_reads(
     sub_rate: float = 0.02,
     ins_rate: float = 0.01,
     del_rate: float = 0.01,
+    hp_indel_bias: float = 0.0,
 ) -> List[BamRecord]:
     """Simulate noisy reads from `ref` with known (exact) alignments: errors
     are introduced with matching CIGAR ops, so the BAM is self-consistent
-    without needing an aligner."""
+    without needing an aligner.
+
+    ``hp_indel_bias`` turns on the homopolymer error mode (nanopore's
+    dominant error class, which the uniform model underrepresents —
+    VERDICT r3 missing #1): at a position inside a run of length L the
+    indel rates scale by ``1 + bias*(L-1)`` (capped), and biased
+    insertions EXTEND the run (same base) instead of drawing a random
+    one — reproducing the run-length ambiguity that makes consensus
+    polishing hard."""
     n_reads = max(1, coverage * len(ref) // read_len)
+    runs = _run_lengths(ref) if hp_indel_bias > 0 else None
     records = []
     for ridx in range(n_reads):
         start = rng.randrange(0, max(1, len(ref) - read_len))
@@ -134,20 +188,29 @@ def simulate_reads(
 
         i = start
         while i < end:
+            if runs is not None:
+                f = _hp_factor(runs[i], hp_indel_bias)
+                del_i = min(_HP_RATE_CAP, del_rate * f)
+                ins_i = min(_HP_RATE_CAP, ins_rate * f)
+            else:
+                del_i, ins_i = del_rate, ins_rate
             r = rng.random()
-            if r < del_rate and i > start:
+            if r < del_i and i > start:
                 d = rng.randint(1, 2)
                 d = min(d, end - i)
                 push(C.CIGAR_D, d)
                 i += d
                 continue
             b = ref[i]
-            if r < del_rate + sub_rate:
+            if r < del_i + sub_rate:
                 b = rng.choice([x for x in BASES if x != ref[i]])
             seq_parts.append(b)
             push(C.CIGAR_M, 1)
-            if rng.random() < ins_rate:
-                ins = random_seq(rng, rng.randint(1, 2))
+            if rng.random() < ins_i:
+                if runs is not None and runs[i] > 1:
+                    ins = ref[i] * rng.randint(1, 2)  # run extension
+                else:
+                    ins = random_seq(rng, rng.randint(1, 2))
                 seq_parts.append(ins)
                 push(C.CIGAR_I, len(ins))
             i += 1
@@ -168,6 +231,7 @@ def mutate_with_cigar(
     ins_rate: float = 0.0,
     del_rate: float = 0.0,
     max_indel: int = 2,
+    hp_indel_bias: float = 0.0,
 ) -> Tuple[str, Tuple[Tuple[int, int], ...]]:
     """Derive a 'draft' from ``truth`` and return the exact truth-to-draft
     alignment CIGAR (query = truth, reference = draft).
@@ -175,9 +239,13 @@ def mutate_with_cigar(
     Op mapping from the edit script: a substitution stays M; dropping a
     truth base from the draft means truth has a base the draft lacks -> I
     (query-only); extra bases inserted into the draft -> D (ref-only).
+    ``hp_indel_bias`` applies the homopolymer error mode (see
+    :func:`simulate_reads`) — assembler drafts inherit the read error
+    profile, so draft errors concentrate in runs too.
     """
     out: List[str] = []
     cigar: List[Tuple[int, int]] = []
+    runs = _run_lengths(truth) if hp_indel_bias > 0 else None
 
     def push(op: int, length: int = 1):
         if length <= 0:
@@ -187,18 +255,27 @@ def mutate_with_cigar(
         else:
             cigar.append((op, length))
 
-    for ch in truth:
+    for i, ch in enumerate(truth):
+        if runs is not None:
+            f = _hp_factor(runs[i], hp_indel_bias)
+            del_i = min(_HP_RATE_CAP, del_rate * f)
+            ins_i = min(_HP_RATE_CAP, ins_rate * f)
+        else:
+            del_i, ins_i = del_rate, ins_rate
         r = rng.random()
-        if r < del_rate:  # draft lacks this truth base
+        if r < del_i:  # draft lacks this truth base
             push(C.CIGAR_I)
             continue
         b = ch
-        if r < del_rate + sub_rate:
+        if r < del_i + sub_rate:
             b = rng.choice([x for x in BASES if x != ch])
         out.append(b)
         push(C.CIGAR_M)
-        if rng.random() < ins_rate:  # draft gains extra bases
-            ins = random_seq(rng, rng.randint(1, max_indel))
+        if rng.random() < ins_i:  # draft gains extra bases
+            if runs is not None and runs[i] > 1:
+                ins = ch * rng.randint(1, max_indel)  # run extension
+            else:
+                ins = random_seq(rng, rng.randint(1, max_indel))
             out.append(ins)
             push(C.CIGAR_D, len(ins))
     return "".join(out), tuple(cigar)
@@ -328,6 +405,8 @@ def build_synthetic_project(
     read_sub: float = 0.02,
     read_ins: float = 0.01,
     read_del: float = 0.01,
+    hp_indel_bias: float = 0.0,
+    hp_extend: float = 0.0,
 ) -> Dict[str, str]:
     """Write a complete synthetic polishing project into ``out_dir``:
 
@@ -341,6 +420,12 @@ def build_synthetic_project(
     Returns a dict of the file paths plus the contig name. This is the
     data layer behind the end-to-end tests, the verify recipe, and
     examples/synthetic_e2e.py.
+
+    ``hp_indel_bias`` + ``hp_extend`` switch the project to the
+    homopolymer error regime: a run-rich truth genome
+    (:func:`random_genome`) with indels concentrated in runs in both
+    the draft and the reads — the adversarial proxy for real nanopore
+    data (VERDICT r3 task 5).
     """
     import os
 
@@ -348,14 +433,16 @@ def build_synthetic_project(
     from roko_tpu.io.fasta import write_fasta
 
     rng = random.Random(seed)
-    truth = random_seq(rng, genome_len)
+    truth = random_genome(rng, genome_len, hp_extend)
     draft, cig = mutate_with_cigar(
-        rng, truth, sub_rate=draft_sub, ins_rate=draft_ins, del_rate=draft_del
+        rng, truth, sub_rate=draft_sub, ins_rate=draft_ins, del_rate=draft_del,
+        hp_indel_bias=hp_indel_bias,
     )
     t2d = truth_to_draft_map(cig)
     reads_t = simulate_reads(
         rng, truth, 0, coverage=coverage, read_len=read_len,
         sub_rate=read_sub, ins_rate=read_ins, del_rate=read_del,
+        hp_indel_bias=hp_indel_bias,
     )
     reads_d = []
     for r in reads_t:
