@@ -7,14 +7,24 @@ roko/rnn_model.py:40-41). The lax.scan path re-materialises the hidden
 state through HBM every step; these kernels run the whole serial chain
 inside Pallas programs with the hidden state pinned in VMEM scratch.
 
-Design (v2 — single launch per layer, train-capable):
+Design (v3 forward / v2 backward — single launch per layer,
+train-capable):
 
+- **Time-only serial grid (v3 forward).** The TPU walks a Pallas grid
+  sequentially, so v2's ``(S, nb, nt)`` grid ran 2 directions x nb
+  batch blocks as *serial passes* over the 90-step chain — measured at
+  just 7% over the scan path (BASELINE.md "Measured vs model"), because
+  serial step count, not FLOPs, binds this recurrence. v3 keeps ALL
+  directions and batch rows resident and makes time the only grid
+  axis: one 90-step chain per layer, with the per-direction matmul and
+  gate blocks inside a step mutually independent so the scheduler can
+  overlap direction 0's VPU gate math with direction 1's MXU matmul.
+  Falls back to the v2 grid when S*B rows exceed the VMEM budget.
 - **Directions fused into one launch.** Both directions of a layer run
-  in one ``pallas_call`` with grid ``(S, nb, nt)``: direction, batch
-  block, time block. The backward direction's inputs are time-reversed
-  on the host side so the kernel always recurs forward in kernel time;
-  per-direction weights are selected by the direction grid index. One
-  launch per layer instead of two (3 per forward instead of 6).
+  in one ``pallas_call``; the backward direction's inputs are
+  time-reversed on the host side so the kernel always recurs forward
+  in kernel time. One launch per layer instead of two (3 per forward
+  instead of 6).
 - **Time-blocked streaming.** The grid's innermost axis walks time
   blocks while the hidden state carries across iterations in VMEM
   scratch (the TPU grid is sequential, scratch persists). Pallas
@@ -82,6 +92,68 @@ def _pick_blocks(T: int, B: int, hidden: int, itemsize: int, bwd: bool):
         if 2 * t_blk * b_blk * per_row <= _VMEM_BUDGET:
             return t_blk, b_blk
     return 1, b_blk
+
+
+def _pick_tblk_v3(T: int, rows: int, hidden: int, itemsize: int):
+    """Largest divisor-of-T time block that fits the v3 (time-only
+    grid) working set: double-buffered xp[3H]+out[H] streams for ALL
+    ``rows`` plus the resident f32 hidden scratch. Returns None when
+    even t_blk=2 does not fit — the caller then falls back to the
+    batch-blocked v2 grid (correct everywhere, serialises batch
+    blocks)."""
+    per_row = 4 * hidden * itemsize
+    scratch = rows * hidden * 4
+    for t_blk in (d for d in range(T, 0, -1) if T % d == 0):
+        if 2 * t_blk * rows * per_row + scratch <= _VMEM_BUDGET:
+            # t_blk=1 is DMA-per-step but still one 90-step serial
+            # chain — far ahead of v2's S x nb passes at wide batch
+            return t_blk
+    return None
+
+
+def _fwd_kernel_v3(t_blk: int, Bp: int, S: int, hidden: int, cdt, out_dtype):
+    """v3 forward: grid is TIME ONLY. Every direction and every batch
+    row advances together in each sequential grid step, so a batch-512
+    forward runs 90 serial steps instead of v2's 2 dirs x nb blocks x
+    90 (the grid serialisation that left v2 within 7% of the scan path
+    — BASELINE.md "Measured vs model"). The per-direction matmuls and
+    gate blocks inside one step are data-independent, so the Mosaic
+    scheduler can overlap direction 0's VPU gate math with direction
+    1's MXU matmul — the overlap no grid ordering can express."""
+
+    def kernel(xp_ref, whh_ref, bhh_ref, out_ref, h_scratch):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            h_scratch[...] = jnp.zeros_like(h_scratch)
+
+        def step(j, h):  # h: [S*Bp, H] float32
+            xp = xp_ref[j].astype(jnp.float32)  # [S*Bp, 3H]
+            outs = []
+            for s in range(S):
+                hs = h[s * Bp : (s + 1) * Bp]
+                whh = whh_ref[s]  # [H, 3H]
+                bhh = bhh_ref[s].astype(jnp.float32)  # [1, 3H]
+                hp = (
+                    jnp.dot(
+                        hs.astype(cdt), whh,
+                        preferred_element_type=jnp.float32,
+                    )
+                    + bhh
+                )
+                xps = xp[s * Bp : (s + 1) * Bp]
+                r = jax.nn.sigmoid(xps[:, :hidden] + hp[:, :hidden])
+                z = jax.nn.sigmoid(
+                    xps[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden]
+                )
+                n = jnp.tanh(xps[:, 2 * hidden :] + r * hp[:, 2 * hidden :])
+                outs.append((1.0 - z) * n + z * hs)
+            h_new = jnp.concatenate(outs, axis=0)
+            out_ref[j] = h_new.astype(out_dtype)
+            return h_new
+
+        h_scratch[...] = lax.fori_loop(0, t_blk, step, h_scratch[...])
+
+    return kernel
 
 
 def _fwd_kernel(t_blk: int, hidden: int, cdt, out_dtype):
@@ -255,6 +327,36 @@ def _gru_multi_fwd(static, w_ih, b_ih, w_hh, b_hh, x):
     B, T, _ = x.shape
     hidden = w_hh.shape[1]
     cdt = jnp.dtype(cdt_name)
+
+    # v3 when the whole S x B working set fits VMEM (the flagship
+    # shapes do): time-only serial grid, see _fwd_kernel_v3. v2
+    # batch-blocked grid otherwise.
+    Bp16 = _round_up(B, 16)
+    t3 = _pick_tblk_v3(T, S * Bp16, hidden, cdt.itemsize)
+    if t3 is not None:
+        Bp = Bp16
+        xs = _xproj_stacked(static, w_ih, b_ih, x, Bp)
+        R = S * Bp
+        hs = pl.pallas_call(
+            _fwd_kernel_v3(t3, Bp, S, hidden, cdt, cdt),
+            grid=(T // t3,),
+            out_shape=jax.ShapeDtypeStruct((T, R, hidden), cdt),
+            in_specs=[
+                pl.BlockSpec((t3, R, 3 * hidden), lambda k: (k, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((S, hidden, 3 * hidden), lambda k: (0, 0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((S, 1, 3 * hidden), lambda k: (0, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((t3, R, hidden), lambda k: (k, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            scratch_shapes=[pltpu.VMEM((R, hidden), jnp.float32)],
+            interpret=interpret,
+        )(xs, w_hh.astype(cdt), b_hh.reshape(S, 1, 3 * hidden))
+        per_dir = _unstack_dirs(hs, flags, B, Bp)
+        ys = jnp.stack(per_dir, axis=0)  # [S,B,T,H]
+        return ys, (w_ih, b_ih, w_hh, b_hh, x, ys)
 
     t_blk, b_blk = _pick_blocks(T, B, hidden, cdt.itemsize, bwd=False)
     Bp = _round_up(B, b_blk)
