@@ -1,0 +1,72 @@
+"""Mechanics of the multi-species protocol (examples/
+multispecies_protocol.py; ref evaluation design README.md:97-101):
+training must consume a DIRECTORY of per-species HDF5 files with a
+separate val species driving early stopping, and the held-out species
+must flow through inference. Accuracy at this scale is covered by
+test_end_to_end; this test pins the multi-file/val wiring."""
+
+import os
+
+from roko_tpu.cli import main as cli
+from roko_tpu.data.hdf5 import hdf5_files, load_training_arrays
+from roko_tpu.io.fasta import read_fasta
+from roko_tpu.sim import build_synthetic_project
+
+
+def test_multispecies_train_val_test_wiring(tmp_path):
+    wd = str(tmp_path)
+    train_dir = os.path.join(wd, "train")
+    os.makedirs(train_dir)
+
+    roles = ["train0", "train1", "val", "test"]
+    projects = {}
+    for i, role in enumerate(roles):
+        projects[role] = build_synthetic_project(
+            os.path.join(wd, f"sp_{role}"),
+            seed=50 + i,
+            genome_len=2_500,
+            contig=f"ctg_{role}",
+            coverage=12,
+            read_len=300,
+        )
+
+    for i, role in enumerate(["train0", "train1", "val"]):
+        p = projects[role]
+        out = (
+            os.path.join(train_dir, f"{role}.hdf5")
+            if role.startswith("train")
+            else os.path.join(wd, "val.hdf5")
+        )
+        assert cli([
+            "features", p["draft_fasta"], p["reads_bam"], out,
+            "--Y", p["truth_bam"], "--seed", str(i),
+        ]) == 0
+
+    # the train directory really holds one file per species, and the
+    # directory reader sees them all
+    assert len(hdf5_files(train_dir)) == 2
+    x_all, _ = load_training_arrays(train_dir)
+    x0, _ = load_training_arrays(os.path.join(train_dir, "train0.hdf5"))
+    assert len(x_all) > len(x0) > 0
+
+    ckpt = os.path.join(wd, "ckpt")
+    assert cli([
+        "train", train_dir, ckpt, "--val", os.path.join(wd, "val.hdf5"),
+        "--b", "32", "--epochs", "2", "--lr", "1e-3", "--dp", "8",
+        "--no-resume",
+    ]) == 0
+    # best-by-val checkpoint layout written
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+
+    test_p = projects["test"]
+    infer_h5 = os.path.join(wd, "infer.hdf5")
+    assert cli([
+        "features", test_p["draft_fasta"], test_p["reads_bam"], infer_h5,
+        "--seed", "9",
+    ]) == 0
+    polished = os.path.join(wd, "polished.fasta")
+    assert cli([
+        "inference", infer_h5, ckpt, polished, "--b", "32", "--dp", "8",
+    ]) == 0
+    (name, seq), = read_fasta(polished)
+    assert name == "ctg_test" and len(seq) > 0
