@@ -122,9 +122,12 @@ def bench_infer(cfg, batch: int = BATCH, iters: int = ITERS) -> float:
     return batch * iters / dt
 
 
-def bench_train(cfg, batch: int = BATCH, iters: int = ITERS) -> Dict[str, float]:
+def bench_train(
+    cfg, batch: int = BATCH, iters: int = ITERS, rng_impl: str = "threefry"
+) -> Dict[str, float]:
     """Training step-time (fwd+bwd+Adam) on a single-device mesh:
-    returns {"step_ms", "windows_per_sec"}."""
+    returns {"step_ms", "windows_per_sec"}. ``rng_impl`` selects the
+    dropout-mask PRNG (TrainConfig.dropout_rng_impl A/B)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -147,7 +150,11 @@ def bench_train(cfg, batch: int = BATCH, iters: int = ITERS) -> Dict[str, float]
     ).astype(np.uint8)
     y = rng.integers(0, C.NUM_CLASSES, (batch, C.WINDOW_COLS)).astype(np.uint8)
     w = np.ones((batch,), np.float32)
-    dropout_rng = jax.random.PRNGKey(1)
+    dropout_rng = (
+        jax.random.PRNGKey(1)
+        if rng_impl == "threefry"
+        else jax.random.key(1, impl=rng_impl)
+    )
 
     params, opt_state = state.params, state.opt_state
     step_no = jnp.zeros((), jnp.int32)
@@ -291,6 +298,10 @@ def run_train_suite(
         "train_gru_remat": ModelConfig(
             compute_dtype="bfloat16", remat_frontend=True
         ),
+        # second anomaly lever: same model, rbg dropout-mask PRNG
+        # (TrainConfig.dropout_rng_impl) — three threefry masks per
+        # step sit inside the fwd+bwd pipeline
+        "train_gru_rbg": ModelConfig(compute_dtype="bfloat16"),
         "train_scan_stress": ModelConfig(
             compute_dtype="bfloat16", num_layers=4, hidden_size=256
         ),
@@ -311,7 +322,11 @@ def run_train_suite(
             out[name] = {"error": f"skipped: {budget_s:.0f}s bench budget spent"}
             continue
         try:
-            r = bench_train(cfg, batch)
+            r = bench_train(
+                cfg,
+                batch,
+                rng_impl="rbg" if name.endswith("_rbg") else "threefry",
+            )
             r["windows_per_sec"] = round(r["windows_per_sec"], 1)
             r["step_ms"] = round(r["step_ms"], 2)
             if peak and cfg.kind == "gru":

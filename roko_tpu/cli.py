@@ -109,6 +109,7 @@ def _build_config(args: argparse.Namespace):
         base.train,
         batch_size="b", epochs="epochs", lr="lr", patience="patience",
         seed="seed", in_memory="memory", val_fraction="val_fraction",
+        dropout_rng_impl="dropout_rng_impl",
     )
     mesh = over(base.mesh, dp="dp", tp="tp", sp="sp")
     return RokoConfig(
@@ -383,6 +384,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--patience", type=int, default=None)
     p.add_argument("--seed", type=int, default=None)
+    p.add_argument(
+        "--dropout-rng-impl", default=None, choices=("threefry", "rbg"),
+        help="PRNG for dropout masks; rbg is the cheap hardware-RNG "
+        "path on TPU (see TrainConfig.dropout_rng_impl)",
+    )
     p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace of the first epoch here")
     p.add_argument(
         "--no-resume",

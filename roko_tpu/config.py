@@ -109,6 +109,15 @@ class TrainConfig:
     prefetch: int = 2
     #: in-epoch heartbeat: log rate/ETA every N steps (0 disables)
     log_every_steps: int = 200
+    #: PRNG implementation for the dropout-mask stream: "threefry"
+    #: (jax default, counter-based, costly mask generation on TPU) or
+    #: "rbg" (hardware RNG path, much cheaper per mask). One of the
+    #: levers on the train-backward anomaly (BASELINE.md): three
+    #: dropout masks per step are generated inside the fwd+bwd
+    #: pipeline. Training-reproducibility note: the mask stream
+    #: differs between impls; resume mixes streams only if the flag is
+    #: changed mid-run.
+    dropout_rng_impl: str = "threefry"
 
 
 @dataclass(frozen=True)

@@ -250,6 +250,12 @@ def train(
     tx = optax.adam(tcfg.lr)
     root = jax.random.PRNGKey(tcfg.seed)
     init_rng, dropout_rng = jax.random.split(root)
+    if tcfg.dropout_rng_impl != "threefry":
+        # dropout-mask stream only (init stays threefry so params are
+        # impl-independent); fold_in/split on this key inherit the impl
+        dropout_rng = jax.random.key(
+            tcfg.seed + 1, impl=tcfg.dropout_rng_impl
+        )
     state = create_state(model, tx, init_rng)
     state = TrainState(
         put_replicated(state.params, mesh),
