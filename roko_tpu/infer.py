@@ -37,7 +37,7 @@ from jax.sharding import Mesh
 
 from roko_tpu import constants as C
 from roko_tpu.config import RokoConfig
-from roko_tpu.data.hdf5 import iter_inference_windows, load_contigs
+from roko_tpu.data.hdf5 import SlabPool, iter_inference_windows, load_contigs
 from roko_tpu.io.fasta import write_fasta
 from roko_tpu.models.model import RokoModel
 from roko_tpu.parallel.mesh import (
@@ -314,7 +314,7 @@ def run_inference(
     timer = StageTimer()
 
     def place(item):
-        names, positions, x = item
+        names, positions, x, release = item
         n = len(names)
         if n < batch_size:  # fixed shapes keep one compiled executable
             pad = batch_size - n
@@ -322,7 +322,7 @@ def run_inference(
         # device_put dispatches asynchronously, so timing it here would
         # read ~0 and misattribute the transfer to the predict span —
         # transfer cost shows up inside "predict+d2h"
-        return names, positions, jax.device_put(x, sharding), n
+        return names, positions, jax.device_put(x, sharding), n, release
 
     t0 = time.perf_counter()
     n_windows = 0
@@ -332,31 +332,35 @@ def run_inference(
         # fetch and voting, so host-side vote accumulation overlaps
         # device compute instead of serialising with it. The
         # "predict+d2h" span therefore measures time actually BLOCKED
-        # on the device, not raw step time.
-        pending = None  # (names, positions, preds_future, n)
-        for names, positions, x, n in prefetch_to_device(
+        # on the device, not raw step time. Slab buffers recycle
+        # through a SlabPool; a batch's release runs after its vote,
+        # when its position/example views are dead (the device_put
+        # transfer finished before its predict results came back).
+        pool = SlabPool()
+        pending = None  # (names, positions, preds_future, n, release)
+
+        def drain(entry):
+            pnames, ppos, pfut, pn, prelease = entry
+            with timer("predict+d2h"):
+                preds = np.asarray(jax.device_get(pfut))[:pn]
+            with timer("vote"):
+                board.add(pnames, ppos, preds)
+            prelease()
+            return pn
+
+        for names, positions, x, n, release in prefetch_to_device(
             iter_inference_windows(
-                data_path, batch_size, contig_filter=contig_filter
+                data_path, batch_size, contig_filter=contig_filter, pool=pool
             ),
             prefetch,
             place,
         ):
             fut = predict(params, x)
             if pending is not None:
-                pnames, ppos, pfut, pn = pending
-                with timer("predict+d2h"):
-                    preds = np.asarray(jax.device_get(pfut))[:pn]
-                with timer("vote"):
-                    board.add(pnames, ppos, preds)
-                n_windows += pn
-            pending = (names, positions, fut, n)
+                n_windows += drain(pending)
+            pending = (names, positions, fut, n, release)
         if pending is not None:
-            pnames, ppos, pfut, pn = pending
-            with timer("predict+d2h"):
-                preds = np.asarray(jax.device_get(pfut))[:pn]
-            with timer("vote"):
-                board.add(pnames, ppos, preds)
-            n_windows += pn
+            n_windows += drain(pending)
     dt = time.perf_counter() - t0
     log(
         f"inference: {n_windows} windows in {dt:.1f}s "

@@ -77,6 +77,33 @@ def test_run_features_infer(synthetic):
     assert total == n
 
 
+def test_pooled_reader_matches_fresh_and_recycles(synthetic):
+    """SlabPool mode must deliver bit-identical batches (via copies,
+    since pooled arrays die at release) and actually recycle buffers:
+    release() feeds the free list the next acquire drains."""
+    from roko_tpu.data.hdf5 import SlabPool
+
+    out = str(synthetic["tmp"] / "pooled.hdf5")
+    n = run_features(synthetic["fasta"], synthetic["bam_x"], out, seed=5)
+    assert n > 0
+    fresh = list(iter_inference_windows(out, batch_size=7, slab=16))
+    pool = SlabPool()
+    pooled = []
+    for names, p, x, release in iter_inference_windows(
+        out, batch_size=7, slab=16, pool=pool
+    ):
+        pooled.append((names, p.copy(), x.copy()))
+        release()
+    assert len(fresh) == len(pooled)
+    for (nc, np_, nx), (pc, pp, px) in zip(fresh, pooled):
+        assert nc == pc
+        assert (np_ == pp).all() and (nx == px).all()
+    # recycling happened: far fewer distinct buffers than slabs read
+    n_slabs = -(-n // 16)
+    pooled_buffers = sum(len(v) for v in pool._free.values())
+    assert 0 < pooled_buffers < n_slabs
+
+
 def test_run_features_train(synthetic):
     out = str(synthetic["tmp"] / "train.hdf5")
     n = run_features(
