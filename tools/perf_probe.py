@@ -82,6 +82,9 @@ def train_step_rows(batch):
     else:
         print("(pallas rows skipped: backend is not TPU, the flag would "
               "silently time the scan path)")
+    # rbg dropout-mask stream (TrainConfig.dropout_rng_impl lever on
+    # the backward anomaly) — same model as train_step, cheaper masks
+    variants["train_step+rbg"] = ModelConfig(compute_dtype="bfloat16")
     for name, cfg in variants.items():
         model = RokoModel(cfg)
         tx = optax.adam(1e-4)
@@ -89,7 +92,11 @@ def train_step_rows(batch):
         step = make_train_step(model, tx, mesh)
         params, opt = state.params, state.opt_state
         sn = jnp.zeros((), jnp.int32)
-        dr = jax.random.PRNGKey(1)
+        dr = (
+            jax.random.key(1, impl="rbg")
+            if name.endswith("+rbg")
+            else jax.random.PRNGKey(1)
+        )
         # donation consumes params/opt, so time a self-feeding loop
         for _ in range(3):
             params, opt, loss, _ = step(params, opt, sn, x, y, w, dr)
