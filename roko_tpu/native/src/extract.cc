@@ -133,23 +133,36 @@ ExtractResult ExtractWindows(const std::string& bam_path,
 
   SplitMix64 rng(seed);
   std::deque<int64_t> pos_queue;
-  // (rpos, ins) -> per-read first-seen code; insertion into the inner
-  // vector preserves "setdefault" (first write wins) via Seen lookup
-  struct ColInfo {
-    std::vector<std::pair<int, uint8_t>> codes;  // (rid, code), rid unique
-    // The sweep visits each (read, column) pair exactly once (one
-    // ColState per covered column), so rids are unique per key by
-    // construction — a plain append matches the oracle's dict setdefault
-    // without the O(coverage) membership scan.
-    void SetDefault(int rid, uint8_t code) { codes.emplace_back(rid, code); }
+  // (rpos, ins) -> per-read first-seen code list. The sweep visits each
+  // (read, column) pair exactly once (one ColState per covered column),
+  // so rids are unique per key by construction — a plain append matches
+  // the oracle's dict setdefault without the O(coverage) membership
+  // scan. The per-key code vectors are POOLED: a region touches
+  // hundreds of thousands of keys, and allocating/destroying a short
+  // vector per key was steady-state malloc churn in the r4 extraction
+  // profile — recycled vectors keep their capacity instead.
+  using Codes = std::vector<std::pair<int, uint8_t>>;  // (rid, code)
+  std::vector<Codes> code_pool;
+  std::vector<uint32_t> pool_free;
+  auto pool_acquire = [&]() -> uint32_t {
+    if (!pool_free.empty()) {
+      uint32_t i = pool_free.back();
+      pool_free.pop_back();
+      return i;
+    }
+    code_pool.emplace_back();
+    return static_cast<uint32_t>(code_pool.size() - 1);
   };
-  std::unordered_map<int64_t, ColInfo> align_info;
-  // rid -> (ref bounds, strand), recorded at first non-refskip entry
+  std::unordered_map<int64_t, uint32_t> align_info;  // key -> pool index
+  // rid -> (ref bounds, strand), recorded at first non-refskip entry.
+  // rids are dense 0..n-1, so a flat array beats a hash map in the
+  // per-column hot loop.
   struct Bounds {
     int32_t lo, hi;
     bool fwd;
   };
-  std::unordered_map<int, Bounds> bounds;
+  std::vector<Bounds> bounds(reads.size());
+  std::vector<bool> have_bounds(reads.size(), false);
 
   int64_t lo = reads.front().pos;
   for (const auto& r : reads) lo = std::min<int64_t>(lo, r.pos);
@@ -172,32 +185,39 @@ ExtractResult ExtractWindows(const std::string& bam_path,
   // row construction the Python oracle uses is O(cols * coverage) per
   // sampled read; with 200 samples over ~coverage reads nearly every
   // read is materialised anyway, so batch-building is strictly cheaper).
+  // All scratch persists ACROSS windows: the row vectors keep their
+  // capacity (fresh per-window allocations were the top line of the r4
+  // extraction profile), and rid->slot is a flat array over the dense
+  // rid space reset via the touched list instead of a rebuilt hash map.
   constexpr uint8_t kUnset = 0xFE;
-  std::unordered_map<int, size_t> rid_slot;
+  constexpr int32_t kNoSlot = -1;
+  std::vector<int32_t> rid_slot(reads.size(), kNoSlot);
   std::vector<int> slot_rid;
   std::vector<std::vector<uint8_t>> rows_buf;
   std::vector<bool> slot_valid;
+  std::vector<int> valid;
 
   auto emit_windows = [&]() {
     while (static_cast<int>(pos_queue.size()) >= cfg.cols) {
-      rid_slot.clear();
+      for (int rid : slot_rid) rid_slot[rid] = kNoSlot;
       slot_rid.clear();
-      rows_buf.clear();
       slot_valid.clear();
+      size_t rows_used = 0;
 
       for (int c = 0; c < cfg.cols; ++c) {
-        const ColInfo& info = align_info[pos_queue[c]];
-        for (const auto& p : info.codes) {
-          auto it = rid_slot.find(p.first);
-          size_t slot;
-          if (it == rid_slot.end()) {
-            slot = rows_buf.size();
-            rid_slot.emplace(p.first, slot);
+        const Codes& codes = code_pool[align_info[pos_queue[c]]];
+        for (const auto& p : codes) {
+          int32_t slot = rid_slot[p.first];
+          if (slot == kNoSlot) {
+            slot = static_cast<int32_t>(rows_used);
+            rid_slot[p.first] = slot;
             slot_rid.push_back(p.first);
-            rows_buf.emplace_back(cfg.cols, kUnset);
+            if (rows_used == rows_buf.size())
+              rows_buf.emplace_back(cfg.cols, kUnset);
+            else
+              rows_buf[rows_used].assign(cfg.cols, kUnset);
+            ++rows_used;
             slot_valid.push_back(false);
-          } else {
-            slot = it->second;
           }
           rows_buf[slot][c] = p.second;
           if (p.second != kUnknown) slot_valid[slot] = true;
@@ -205,7 +225,7 @@ ExtractResult ExtractWindows(const std::string& bam_path,
       }
 
       // valid reads: any non-UNKNOWN code within the window, sorted by id
-      std::vector<int> valid;
+      valid.clear();
       for (size_t s = 0; s < slot_rid.size(); ++s)
         if (slot_valid[s]) valid.push_back(slot_rid[s]);
       std::sort(valid.begin(), valid.end());
@@ -213,8 +233,8 @@ ExtractResult ExtractWindows(const std::string& bam_path,
       if (!valid.empty()) {
         const size_t n_valid = valid.size();
         // complete the rows: bounds rule for unset columns, strand offset
-        for (size_t s = 0; s < rows_buf.size(); ++s) {
-          const Bounds& b = bounds.at(slot_rid[s]);
+        for (size_t s = 0; s < rows_used; ++s) {
+          const Bounds& b = bounds[slot_rid[s]];
           std::vector<uint8_t>& row = rows_buf[s];
           for (int c = 0; c < cfg.cols; ++c) {
             if (row[c] == kUnset) {
@@ -242,7 +262,7 @@ ExtractResult ExtractWindows(const std::string& bam_path,
                              static_cast<size_t>(cfg.rows) * cfg.cols);
         for (int r = 0; r < cfg.rows; ++r) {
           int rid = valid[rng.NextBelow(n_valid)];
-          const std::vector<uint8_t>& row = rows_buf[rid_slot.at(rid)];
+          const std::vector<uint8_t>& row = rows_buf[rid_slot[rid]];
           std::copy(row.begin(), row.end(),
                     result.matrix.begin() + mat_base +
                         static_cast<size_t>(r) * cfg.cols);
@@ -251,7 +271,10 @@ ExtractResult ExtractWindows(const std::string& bam_path,
       }
       // slide by stride (empty valid set: skip but still slide)
       for (int s = 0; s < cfg.stride; ++s) {
-        align_info.erase(pos_queue.front());
+        auto it = align_info.find(pos_queue.front());
+        code_pool[it->second].clear();  // keep capacity for reuse
+        pool_free.push_back(it->second);
+        align_info.erase(it);
         pos_queue.pop_front();
       }
     }
@@ -277,32 +300,44 @@ ExtractResult ExtractWindows(const std::string& bam_path,
     if (rpos < start) continue;
     if (rpos >= end) break;
 
+    // the base (ins=0) column key is shared by every read at this
+    // rpos: resolve it at most once per rpos, not once per read
+    // (lazily, so an all-refskip column still creates no key). Index,
+    // not pointer — pool growth during insertion handling would
+    // invalidate a pointer.
+    constexpr uint32_t kNoIdx = ~0u;
+    uint32_t base_idx = kNoIdx;
     for (size_t idx : active) {
       const ReadInfo& r = reads[idx];
       const ColState& st = r.states[static_cast<size_t>(rpos - r.pos)];
       if (st.is_refskip) continue;
-      if (bounds.find(r.id) == bounds.end())
-        bounds.emplace(r.id, Bounds{r.pos, r.ref_end, !r.reverse});
+      if (!have_bounds[r.id]) {
+        bounds[r.id] = Bounds{r.pos, r.ref_end, !r.reverse};
+        have_bounds[r.id] = true;
+      }
 
-      int64_t base_key = key_of(rpos, 0);
-      auto ai = align_info.find(base_key);
-      if (ai == align_info.end()) {
-        ai = align_info.emplace(base_key, ColInfo{}).first;
-        pos_queue.push_back(base_key);
+      if (base_idx == kNoIdx) {
+        int64_t base_key = key_of(rpos, 0);
+        auto ai = align_info.find(base_key);
+        if (ai == align_info.end()) {
+          ai = align_info.emplace(base_key, pool_acquire()).first;
+          pos_queue.push_back(base_key);
+        }
+        base_idx = ai->second;
       }
       if (st.is_del) {
-        ai->second.SetDefault(r.id, kGap);
+        code_pool[base_idx].emplace_back(r.id, kGap);
       } else {
-        ai->second.SetDefault(r.id, encode_base(r, st.qpos));
+        code_pool[base_idx].emplace_back(r.id, encode_base(r, st.qpos));
         int32_t n_ins = std::min(st.indel, cfg.max_ins);
         for (int32_t i = 1; i <= n_ins; ++i) {
           int64_t ikey = key_of(rpos, i);
           auto ii = align_info.find(ikey);
           if (ii == align_info.end()) {
-            ii = align_info.emplace(ikey, ColInfo{}).first;
+            ii = align_info.emplace(ikey, pool_acquire()).first;
             pos_queue.push_back(ikey);
           }
-          ii->second.SetDefault(r.id, encode_base(r, st.qpos + i));
+          code_pool[ii->second].emplace_back(r.id, encode_base(r, st.qpos + i));
         }
       }
     }
