@@ -204,13 +204,24 @@ class VoteBoard:
                 self._scatter(board, pos[:, :, 0][base], prd[base], name)
             ins_map = self._ins[name]
             flat = pos[:, :, 0][ins_mask] * _SLOTS + pos[:, :, 1][ins_mask]
-            for slot, p in zip(flat.tolist(), prd[ins_mask].tolist()):
-                counts = ins_map.get(slot)
-                if counts is None:
-                    counts = ins_map[slot] = np.zeros(C.NUM_CLASSES, np.uint16)
-                if counts[p] >= self.SAT_LIMIT:
-                    self._check_saturation(int(counts[p]), name)
-                counts[p] += 1
+            if flat.size:
+                # pre-aggregate duplicate (slot, class) votes (adjacent
+                # windows overlap ~cols/stride-fold, so most slots carry
+                # several votes per batch): one dict visit per UNIQUE
+                # pair instead of per vote
+                comb = flat * C.NUM_CLASSES + prd[ins_mask]
+                uniq, cnt = np.unique(comb, return_counts=True)
+                for u, votes in zip(uniq.tolist(), cnt.tolist()):
+                    slot, p = divmod(u, C.NUM_CLASSES)
+                    counts = ins_map.get(slot)
+                    if counts is None:
+                        counts = ins_map[slot] = np.zeros(
+                            C.NUM_CLASSES, np.uint16
+                        )
+                    total = int(counts[p]) + votes
+                    if total >= self.SAT_LIMIT:
+                        self._check_saturation(total, name)
+                    counts[p] = total
         else:
             flat = pos[:, :, 0] * _SLOTS + pos[:, :, 1]
             self._scatter(board, flat.ravel(), prd.ravel(), name)
