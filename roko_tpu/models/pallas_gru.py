@@ -94,14 +94,16 @@ def _pick_blocks(T: int, B: int, hidden: int, itemsize: int, bwd: bool):
     return 1, b_blk
 
 
-def _pick_tblk_v3(T: int, rows: int, hidden: int, itemsize: int):
+def _pick_tblk_v3(T: int, rows: int, hidden: int, itemsize: int,
+                  bwd: bool = False):
     """Largest divisor-of-T time block that fits the v3 (time-only
-    grid) working set: double-buffered xp[3H]+out[H] streams for ALL
-    ``rows`` plus the resident f32 hidden scratch. Returns None when
-    even t_blk=2 does not fit — the caller then falls back to the
+    grid) working set: double-buffered streams for ALL ``rows``
+    (fwd: xp[3H]+out[H]; bwd: xp[3H]+h[H]+dy[H]+dxp[3H]+boundary slack)
+    plus the resident f32 carry scratch. Returns None when even
+    t_blk=1 does not fit — the caller then falls back to the
     batch-blocked v2 grid (correct everywhere, serialises batch
     blocks)."""
-    per_row = 4 * hidden * itemsize
+    per_row = (9 if bwd else 4) * hidden * itemsize
     scratch = rows * hidden * 4
     for t_blk in (d for d in range(T, 0, -1) if T % d == 0):
         if 2 * t_blk * rows * per_row + scratch <= _VMEM_BUDGET:
@@ -181,6 +183,111 @@ def _fwd_kernel(t_blk: int, hidden: int, cdt, out_dtype):
             return h_new
 
         h_scratch[...] = lax.fori_loop(0, t_blk, step, h_scratch[...])
+
+    return kernel
+
+
+def _bwd_kernel_v3(
+    t_blk: int, nt: int, Bp: int, S: int, hidden: int, cdt, dxp_dtype
+):
+    """v3 backward: time-only reverse sweep with every direction and
+    batch row resident (see _fwd_kernel_v3 for why the grid shape is
+    the perf lever). dW_hh/db_hh accumulate in constant-index output
+    blocks that stay resident across the whole grid; dh carries in
+    scratch; per-direction blocks inside a step are independent, so the
+    two directions' matmuls and gate math can overlap."""
+
+    def kernel(
+        xp_ref, h_ref, hprev_ref, dy_ref, whh_ref, bhh_ref,
+        dxp_ref, dwhh_ref, dbhh_ref, dh_scratch,
+    ):
+        k = pl.program_id(0)
+
+        @pl.when(k == 0)
+        def _init():
+            dh_scratch[...] = jnp.zeros_like(dh_scratch)
+            dwhh_ref[...] = jnp.zeros(dwhh_ref.shape, dwhh_ref.dtype)
+            dbhh_ref[...] = jnp.zeros(dbhh_ref.shape, dbhh_ref.dtype)
+
+        first_time_block = k == nt - 1  # time blocks walked in reverse
+
+        def step(jj, carry):
+            # per-direction accumulators ride as TUPLES (S is static):
+            # a stacked [S,H,3H] carry would need .at[s].add, which
+            # lowers to scatter-add — unimplemented in Pallas TPU
+            dh_all, dwhhs, dbhhs = carry
+            dwhhs, dbhhs = list(dwhhs), list(dbhhs)
+            j = t_blk - 1 - jj
+            xp_row = xp_ref[j]
+            h_row = h_ref[jnp.maximum(j - 1, 0)]
+            hb_row = hprev_ref[0]
+            dy_row = dy_ref[j]
+            at_t0 = first_time_block & (j == 0)
+            da_parts = []
+            dh_parts = []
+            for s in range(S):
+                rows = slice(s * Bp, (s + 1) * Bp)
+                whh = whh_ref[s]  # [H, 3H]
+                bhh = bhh_ref[s].astype(jnp.float32)  # [1, 3H]
+                xp = xp_row[rows].astype(jnp.float32)
+                h_in_blk = h_row[rows].astype(jnp.float32)
+                h_boundary = hb_row[rows].astype(jnp.float32)
+                h_prev = jnp.where(
+                    j > 0,
+                    h_in_blk,
+                    jnp.where(
+                        at_t0, jnp.zeros_like(h_boundary), h_boundary
+                    ),
+                )
+                hp = (
+                    jnp.dot(
+                        h_prev.astype(cdt), whh,
+                        preferred_element_type=jnp.float32,
+                    )
+                    + bhh
+                )
+                r = jax.nn.sigmoid(xp[:, :hidden] + hp[:, :hidden])
+                z = jax.nn.sigmoid(
+                    xp[:, hidden : 2 * hidden] + hp[:, hidden : 2 * hidden]
+                )
+                hpn = hp[:, 2 * hidden :]
+                n = jnp.tanh(xp[:, 2 * hidden :] + r * hpn)
+
+                dh = dh_all[rows] + dy_row[rows].astype(jnp.float32)
+                dz = dh * (h_prev - n) * z * (1.0 - z)
+                dn_pre = dh * (1.0 - z) * (1.0 - n * n)
+                dr_pre = dn_pre * hpn * r * (1.0 - r)
+                da = jnp.concatenate([dr_pre, dz, dn_pre], axis=1)
+                dhp = jnp.concatenate([dr_pre, dz, dn_pre * r], axis=1)
+                da_parts.append(da.astype(dxp_dtype))
+                dh_parts.append(
+                    dh * z
+                    + jnp.dot(
+                        dhp.astype(cdt), whh.T,
+                        preferred_element_type=jnp.float32,
+                    )
+                )
+                dwhhs[s] = dwhhs[s] + jnp.dot(
+                    h_prev.astype(cdt).T,
+                    dhp.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+                dbhhs[s] = dbhhs[s] + dhp.sum(axis=0, keepdims=True)
+            dxp_ref[j] = jnp.concatenate(da_parts, axis=0)
+            return (
+                jnp.concatenate(dh_parts, axis=0),
+                tuple(dwhhs),
+                tuple(dbhhs),
+            )
+
+        dh0 = dh_scratch[...]
+        dwhh0 = tuple(dwhh_ref[s] for s in range(S))
+        dbhh0 = tuple(dbhh_ref[s] for s in range(S))
+        dh, dwhhs, dbhhs = lax.fori_loop(0, t_blk, step, (dh0, dwhh0, dbhh0))
+        dh_scratch[...] = dh
+        for s in range(S):
+            dwhh_ref[s] = dwhhs[s]
+            dbhh_ref[s] = dbhhs[s]
 
     return kernel
 
@@ -396,18 +503,20 @@ def _gru_multi_bwd(static, res, dys):
     hidden = w_hh.shape[1]
     cdt = jnp.dtype(cdt_name)
 
+    # v3 when the whole S x B working set fits (same grid logic as the
+    # forward: time is the only serial axis)
+    Bp16 = _round_up(B, 16)
+    t3 = _pick_tblk_v3(T, S * Bp16, hidden, cdt.itemsize, bwd=True)
+    if t3 is not None:
+        return _gru_multi_bwd_v3(static, res, dys, t3)
+
     t_blk, b_blk = _pick_blocks(T, B, hidden, cdt.itemsize, bwd=True)
     Bp = _round_up(B, b_blk)
     nb, nt = Bp // b_blk, T // t_blk
 
-    xs = _xproj_stacked(static, w_ih, b_ih, x, Bp)
-    hs = _stack_dirs(list(ys.astype(cdt)), flags, Bp)
-    dy = _stack_dirs(list(dys.astype(cdt)), flags, Bp)
-    # one boundary row per time block (h at the block's last step): the
-    # kernel needs h_{t-1} across block edges but only ONE row of the
-    # previous block — streaming the whole block again would double the
-    # h-stream HBM traffic
-    hs_bound = hs[t_blk - 1 :: t_blk]  # [nt, S*Bp, H]
+    xs, hs, dy, hs_bound = _bwd_prologue(
+        static, w_ih, b_ih, x, ys, dys, Bp, t_blk, cdt
+    )
 
     # time blocks are walked newest-first; hprev is the boundary row one
     # time block earlier (clamped at the start; the kernel masks t == 0)
@@ -451,6 +560,31 @@ def _gru_multi_bwd(static, res, dys):
         interpret=interpret,
     )(xs, hs, hs_bound, dy, w_hh.astype(cdt), b_hh.reshape(S, 1, 3 * hidden))
 
+    return _finish_bwd(
+        flags, w_ih, b_ih, w_hh, b_hh, x, dxp, dwhh, dbhh, B, Bp, hidden
+    )
+
+
+def _bwd_prologue(static, w_ih, b_ih, x, ys, dys, Bp, t_blk, cdt):
+    """Shared backward prologue: stacked x-projection, stored states and
+    upstream grads in kernel-time layout, plus one boundary row per
+    time block (h at the block's last step) — the kernel needs h_{t-1}
+    across block edges but only ONE row of the previous block;
+    streaming the whole block again would double the h-stream HBM
+    traffic."""
+    flags = static[0]
+    xs = _xproj_stacked(static, w_ih, b_ih, x, Bp)
+    hs = _stack_dirs(list(ys.astype(cdt)), flags, Bp)
+    dy = _stack_dirs(list(dys.astype(cdt)), flags, Bp)
+    hs_bound = hs[t_blk - 1 :: t_blk]  # [nt, S*Bp, H]
+    return xs, hs, dy, hs_bound
+
+
+def _finish_bwd(flags, w_ih, b_ih, w_hh, b_hh, x, dxp, dwhh, dbhh, B, Bp,
+                hidden):
+    """Shared backward epilogue: unstack dxp and run the big input-side
+    GEMMs outside the kernel (dx, dW_ih, db_ih)."""
+    S = len(flags)
     dbhh = dbhh.reshape(S, 3 * hidden)
     dxp_dirs = _unstack_dirs(dxp, flags, B, Bp)  # S x [B,T,3H]
     dxp_all = jnp.stack(dxp_dirs, axis=0).astype(jnp.float32)  # [S,B,T,3H]
@@ -465,6 +599,61 @@ def _gru_multi_bwd(static, res, dys):
         dwhh.astype(w_hh.dtype),
         dbhh.astype(b_hh.dtype),
         dx.astype(x.dtype),
+    )
+
+
+def _gru_multi_bwd_v3(static, res, dys, t3: int):
+    flags, interpret, cdt_name = static
+    w_ih, b_ih, w_hh, b_hh, x, ys = res
+    S = len(flags)
+    B, T, _ = x.shape
+    hidden = w_hh.shape[1]
+    cdt = jnp.dtype(cdt_name)
+    Bp = _round_up(B, 16)
+    R = S * Bp
+    nt = T // t3
+
+    xs, hs, dy, hs_bound = _bwd_prologue(
+        static, w_ih, b_ih, x, ys, dys, Bp, t3, cdt
+    )
+
+    def tmap(k):
+        return (nt - 1 - k, 0, 0)
+
+    def tmap_prev(k):
+        return (jnp.maximum(nt - 1 - k - 1, 0), 0, 0)
+
+    const = lambda k: (0, 0, 0)  # noqa: E731
+
+    dxp, dwhh, dbhh = pl.pallas_call(
+        _bwd_kernel_v3(t3, nt, Bp, S, hidden, cdt, cdt),
+        grid=(nt,),
+        out_shape=(
+            jax.ShapeDtypeStruct((T, R, 3 * hidden), cdt),
+            jax.ShapeDtypeStruct((S, hidden, 3 * hidden), jnp.float32),
+            jax.ShapeDtypeStruct((S, 1, 3 * hidden), jnp.float32),
+        ),
+        in_specs=[
+            pl.BlockSpec((t3, R, 3 * hidden), tmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((t3, R, hidden), tmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, R, hidden), tmap_prev, memory_space=pltpu.VMEM),
+            pl.BlockSpec((t3, R, hidden), tmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, hidden, 3 * hidden), const,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, 1, 3 * hidden), const, memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((t3, R, 3 * hidden), tmap, memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, hidden, 3 * hidden), const,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((S, 1, 3 * hidden), const, memory_space=pltpu.VMEM),
+        ),
+        scratch_shapes=[pltpu.VMEM((R, hidden), jnp.float32)],
+        interpret=interpret,
+    )(xs, hs, hs_bound, dy, w_hh.astype(cdt), b_hh.reshape(S, 1, 3 * hidden))
+
+    return _finish_bwd(
+        flags, w_ih, b_ih, w_hh, b_hh, x, dxp, dwhh, dbhh, B, Bp, hidden
     )
 
 

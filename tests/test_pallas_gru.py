@@ -196,6 +196,55 @@ def test_pallas_multi_time_block_path(rng, monkeypatch):
         np.testing.assert_allclose(np.asarray(w), np.asarray(g), rtol=1e-4, atol=1e-4)
 
 
+def test_pallas_v2_fallback_grid_matches_scan(rng, monkeypatch):
+    """Working sets too big for the v3 time-only grid fall back to the
+    v2 (S, nb, nt) batch-blocked grid in BOTH directions of the custom
+    VJP. No in-CI shape is that big, so force the fallback: the v2
+    kernels must stay correct (they are the only path for very large
+    batches)."""
+    import roko_tpu.models.pallas_gru as pg
+
+    monkeypatch.setattr(pg, "_pick_tblk_v3", lambda *a, **k: None)
+    gru = RokoGRU(in_size=24, hidden=16, num_layers=1, dropout=0.0)
+    layer = gru.init(jax.random.PRNGKey(11))[0]
+    x = jnp.asarray(rng.standard_normal((5, 90, 24)), jnp.float32)
+    ct = jnp.asarray(rng.standard_normal((5, 90, 32)), jnp.float32)
+
+    want_y = jnp.concatenate(
+        [
+            gru_direction(layer["fwd"], x, reverse=False),
+            gru_direction(layer["bwd"], x, reverse=True),
+        ],
+        axis=-1,
+    )
+    got_y = pg.fused_bidir_layer(layer, x, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(want_y), np.asarray(got_y), rtol=1e-5, atol=1e-5
+    )
+
+    def loss_scan(p, x):
+        return jnp.sum(
+            jnp.concatenate(
+                [
+                    gru_direction(p["fwd"], x, reverse=False),
+                    gru_direction(p["bwd"], x, reverse=True),
+                ],
+                axis=-1,
+            )
+            * ct
+        )
+
+    def loss_pallas(p, x):
+        return jnp.sum(pg.fused_bidir_layer(p, x, interpret=True) * ct)
+
+    want = jax.grad(loss_scan, argnums=(0, 1))(layer, x)
+    got = jax.grad(loss_pallas, argnums=(0, 1))(layer, x)
+    for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(
+            np.asarray(w), np.asarray(g), rtol=1e-4, atol=1e-4
+        )
+
+
 def test_pallas_bf16_mode_close(rng):
     """bfloat16 compute mode stays within bf16 tolerance of the f32
     scan path (states round-trip through bf16 between steps)."""
