@@ -298,7 +298,13 @@ def run_train_suite(
         "train_gru_remat": ModelConfig(
             compute_dtype="bfloat16", remat_frontend=True
         ),
-        # second anomaly lever: same model, rbg dropout-mask PRNG
+        # anomaly lever 2: recompute the scan cell's gates in the
+        # backward instead of streaming 90 steps of stored activations
+        # (ModelConfig.remat_scan)
+        "train_gru_remat_scan": ModelConfig(
+            compute_dtype="bfloat16", remat_scan=True
+        ),
+        # anomaly lever 3: same model, rbg dropout-mask PRNG
         # (TrainConfig.dropout_rng_impl) — three threefry masks per
         # step sit inside the fwd+bwd pipeline
         "train_gru_rbg": ModelConfig(compute_dtype="bfloat16"),

@@ -82,6 +82,14 @@ class ModelConfig:
     # (BASELINE.md "training backward anomaly"). Off by default until
     # the driver-measured bench row (train_gru_remat) proves it on chip.
     remat_frontend: bool = False
+    # rematerialise the GRU scan cell in the training backward
+    # (jax.checkpoint on the per-step function): the scan backward
+    # otherwise streams every step's gate activations (r/z/n/hp,
+    # ~6 arrays per step x 90 steps) through HBM — the scan-path
+    # analogue of the Pallas backward kernel's recompute-from-h
+    # strategy. Off by default until the driver-measured bench row
+    # (train_gru_remat_scan) proves it on chip.
+    remat_scan: bool = False
 
     @property
     def gru_in_size(self) -> int:

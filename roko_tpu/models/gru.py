@@ -85,7 +85,9 @@ def gru_direction(
     return _gru_scan(x_proj, h0, params["w_hh"], params["b_hh"], reverse)
 
 
-def bidir_layer(layer: Dict[str, Any], x: jax.Array) -> jax.Array:
+def bidir_layer(
+    layer: Dict[str, Any], x: jax.Array, *, remat_cell: bool = False
+) -> jax.Array:
     """Both directions of one layer in a SINGLE ``lax.scan``,
     [B,T,in] -> [B,T,2H] (fwd ++ bwd on the feature axis).
 
@@ -119,7 +121,13 @@ def bidir_layer(layer: Dict[str, Any], x: jax.Array) -> jax.Array:
         return h_new, h_new
 
     h0 = jnp.zeros((2, B, hidden), xp.dtype)
-    _, ys = lax.scan(cell, h0, xs)  # [T,2,B,H]
+    # remat_cell: recompute the gates from (h, xp_t) in the backward
+    # instead of storing r/z/n/hp per step — the stored residual stream
+    # shrinks to the carries the scan keeps anyway (ys) at the cost of
+    # one extra per-step matmul in the backward
+    # (ModelConfig.remat_scan)
+    cell_fn = jax.checkpoint(cell) if remat_cell else cell
+    _, ys = lax.scan(cell_fn, h0, xs)  # [T,2,B,H]
     fwd = ys[:, 0].swapaxes(0, 1)
     bwd = jnp.flip(ys[:, 1].swapaxes(0, 1), axis=1)
     return jnp.concatenate([fwd, bwd], axis=-1)
@@ -132,6 +140,7 @@ def bidir_gru_stack(
     dropout: float = 0.0,
     deterministic: bool = True,
     rng: jax.Array | None = None,
+    remat_cell: bool = False,
 ) -> jax.Array:
     """Stacked bidirectional GRU, [B,T,in] -> [B,T,2H].
 
@@ -141,7 +150,7 @@ def bidir_gru_stack(
     """
     num_layers = len(params)
     for i, layer in enumerate(params):
-        x = bidir_layer(layer, x)
+        x = bidir_layer(layer, x, remat_cell=remat_cell)
         if dropout > 0.0 and not deterministic and i < num_layers - 1:
             assert rng is not None
             rng, sub = jax.random.split(rng)
@@ -159,12 +168,14 @@ class RokoGRU:
         num_layers: int,
         dropout: float,
         use_pallas: bool = False,
+        remat_scan: bool = False,
     ):
         self.in_size = in_size
         self.hidden = hidden
         self.num_layers = num_layers
         self.dropout = dropout
         self.use_pallas = use_pallas
+        self.remat_scan = remat_scan
 
     def init(self, rng: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], ...]:
         layers = []
@@ -203,4 +214,5 @@ class RokoGRU:
             dropout=self.dropout,
             deterministic=deterministic,
             rng=rng,
+            remat_cell=self.remat_scan,
         )

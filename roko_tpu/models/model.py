@@ -64,6 +64,7 @@ class RokoModel:
             self.cfg.num_layers,
             self.cfg.dropout,
             use_pallas=self.cfg.use_pallas,
+            remat_scan=self.cfg.remat_scan,
         )
 
     # -- init ---------------------------------------------------------------

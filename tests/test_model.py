@@ -90,6 +90,29 @@ def test_remat_frontend_matches_baseline_values_and_grads(batch):
     )
 
 
+def test_remat_scan_matches_baseline_values_and_grads(batch):
+    """remat_scan (jax.checkpoint on the GRU scan cell) recomputes the
+    gates in the backward: forward values and every gradient leaf must
+    match the non-remat path to float tolerance."""
+    base = RokoModel(ModelConfig())
+    remat = RokoModel(ModelConfig(remat_scan=True))
+    params = base.init(jax.random.key(3))
+    rng = jax.random.key(9)
+
+    def loss(model, p):
+        out = model.apply(p, batch, deterministic=False, rng=rng)
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    v0, g0 = jax.value_and_grad(lambda p: loss(base, p))(params)
+    v1, g1 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    assert np.allclose(v0, v1, rtol=1e-6, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0,
+        g1,
+    )
+
+
 def test_bidir_layer_matches_per_direction(rng):
     """The single-scan fused bidirectional layer == two gru_direction
     passes (fwd ++ time-reversed bwd)."""

@@ -154,6 +154,17 @@ def test_remat_train_step_compiles_for_v5e(v5e_topo):
     )
 
 
+def test_remat_scan_train_step_compiles_for_v5e(v5e_topo):
+    """The remat_scan train step (the bench's train_gru_remat_scan A/B
+    row): jax.checkpoint INSIDE lax.scan must survive the XLA:TPU
+    pipeline before the driver's bench meets it on a chip."""
+    _compile_train_step_for(
+        v5e_topo,
+        (1, 1, 1),
+        ModelConfig(compute_dtype="bfloat16", remat_scan=True),
+    )
+
+
 def test_transformer_tp_and_ring_sp_compile_for_v5e_mesh(v5e_topo):
     """The other two multi-chip configs the CPU dryrun exercises,
     compiled for real v5e hardware: dp x tp with Megatron-sharded

@@ -69,6 +69,12 @@ def train_step_rows(batch):
     variants = {
         "train_step": ModelConfig(compute_dtype="bfloat16"),
         "train_step+remat": ModelConfig(compute_dtype="bfloat16", remat_frontend=True),
+        "train_step+remat_scan": ModelConfig(
+            compute_dtype="bfloat16", remat_scan=True
+        ),
+        "train_step+remat_both": ModelConfig(
+            compute_dtype="bfloat16", remat_frontend=True, remat_scan=True
+        ),
     }
     from roko_tpu.models.gru import _pallas_backend
 
