@@ -287,12 +287,14 @@ def run_train_suite(
     peak = _device_peak_flops()
     out: Dict[str, Any] = {"batch": batch}
     # Order = information value under a tight budget (each suite costs
-    # ~60-90s of fresh compile; the default 480s budget fits about
-    # four): flagship GRU, then its remat A/B (the driver-measured
-    # evidence for flipping ModelConfig.remat_frontend — BASELINE.md
-    # "training backward anomaly"), then the two remaining BASELINE.md
-    # rows; the fused-Pallas row last because r3 already measured it
-    # within noise of the scan path (177.6 vs 173.1 ms).
+    # ~60-90s of fresh compile; the default 480s budget fits four to
+    # six — rows that don't fit are reported skipped, never hidden):
+    # flagship GRU first, then the three backward-anomaly levers in
+    # descending expected effect (remat_frontend, remat_scan, rbg —
+    # BASELINE.md "training backward anomaly"), then the remaining
+    # BASELINE.md rows; the fused-Pallas row last because r3 measured
+    # v2 within noise of the scan path (the v3 kernels may change
+    # that).
     suites = {
         "train_gru": ModelConfig(compute_dtype="bfloat16"),
         "train_gru_remat": ModelConfig(
