@@ -784,11 +784,14 @@ def main(argv=None) -> None:
         # Fallback of record: a CPU run that still produces every field,
         # honestly labelled. Reduced batch keeps it fast; env.backend
         # says "cpu" and tpu_error says why, so the artifact can never
-        # masquerade as a chip measurement.
+        # masquerade as a chip measurement. The host-extraction suite
+        # is included — it is chip-independent evidence and the only
+        # genuinely meaningful throughput a CPU run can contribute.
         log(f"[bench] falling back to CPU: {why}")
         _force_cpu_backend()
         if args.batch is None:
             args.batch = 64
+        args.features = True
         result = _measure(args)
         result["detail"].setdefault("env", {})["tpu_error"] = why[:600]
         _emit(result, args.out)
