@@ -284,6 +284,7 @@ def run_inference(
     prefetch: int = 2,
     trace_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
+    vote_sparse_threshold: Optional[int] = None,
 ) -> Dict[str, str]:
     """Predict votes for every window in ``data_path`` and stitch each
     contig; returns {contig: polished_seq}. ``trace_dir`` writes a
@@ -321,7 +322,14 @@ def run_inference(
     predict = make_predict_step(model, mesh)
     sharding = data_sharding(mesh)
 
-    board = VoteBoard(contigs)
+    # vote_sparse_threshold overrides the dense/sparse board switch
+    # (default 32 Mb): tests force the sparse representation through
+    # the full pipeline; genome-scale callers can pin either mode
+    board = (
+        VoteBoard(contigs, sparse_threshold=vote_sparse_threshold)
+        if vote_sparse_threshold is not None
+        else VoteBoard(contigs)
+    )
     timer = StageTimer()
 
     def place(item):

@@ -137,6 +137,43 @@ def test_run_inference_end_to_end(rng, tmp_path):
     assert any("windows/s" in l for l in logs)
 
 
+def test_run_inference_sparse_board_matches_dense(rng, tmp_path):
+    """The SAME hdf5 polished through the dense and the
+    sparse-insertions vote-board representations must produce identical
+    FASTA — the full-pipeline guarantee behind the 32 Mb switch (the
+    unit tests cover the boards in isolation; this drives them through
+    run_inference's batch loop, prefetch, and stitch)."""
+    draft = "".join(rng.choice(list("ACGT"), 400))
+    n, B, W = 5, 200, 90
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, B, W)).astype(np.uint8)
+    positions = []
+    for i in range(n):
+        start = i * C.WINDOW_STRIDE
+        pos = np.stack(
+            [np.arange(start, start + W), np.zeros(W, np.int64)], axis=1
+        )
+        pos[3::11, 1] = 1  # insertion slots exercise the sparse map
+        pos[3::11, 0] = pos[2::11, 0][: len(pos[3::11, 0])]
+        positions.append(pos)
+
+    path = tmp_path / "infer.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", positions, list(X), None)
+
+    cfg = RokoConfig(model=TINY, mesh=MeshConfig(dp=8))
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    dense = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None,
+        vote_sparse_threshold=10**9,
+    )
+    sparse = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None,
+        vote_sparse_threshold=0,
+    )
+    assert dense == sparse
+
+
 def test_predict_step_batch_invariance(rng):
     """Same windows, different batch padding -> same predictions."""
     model = RokoModel(TINY)
