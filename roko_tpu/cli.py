@@ -222,6 +222,10 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--batch", str(args.b)]
     if args.out:
         argv += ["--out", args.out]
+    if args.e2e_draft is not None:
+        argv += ["--e2e-draft", str(args.e2e_draft)]
+    if args.in_process:
+        argv.append("--in-process")
     bench_main(argv)
     return 0
 
@@ -451,6 +455,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="exact benchmark batch size (default: sweep on TPU)",
     )
     p.add_argument("--out", default=None, help="write full results JSON here")
+    p.add_argument(
+        "--e2e-draft", type=int, default=None,
+        help="end-to-end suite draft length (0 disables; default "
+        "2 Mb on TPU, 60 kb elsewhere)",
+    )
+    p.add_argument(
+        "--in-process",
+        action="store_true",
+        help="skip the sick-backend probe/fallback orchestration",
+    )
     p.set_defaults(fn=cmd_bench)
 
     p = sub.add_parser(
