@@ -481,6 +481,7 @@ def _measure(args) -> Dict[str, Any]:
         "backend": jax.default_backend(),
         "device_kind": jax.devices()[0].device_kind,
         "jax": jax.__version__,
+        "git": _git_rev(),
     }
     windows_per_sec = detail["windows_per_sec"]
     return {
@@ -490,6 +491,24 @@ def _measure(args) -> Dict[str, Any]:
         "vs_baseline": round(windows_per_sec / ref_windows_per_sec, 2),
         "detail": detail,
     }
+
+
+def _git_rev() -> str:
+    """Short sha of the measured tree (cross-round artifact provenance);
+    'unknown' outside a git checkout."""
+    import os
+    import subprocess
+
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
 
 
 def _emit(result: Dict[str, Any], out_path) -> None:
@@ -764,9 +783,9 @@ def main(argv=None) -> None:
         except ValueError:
             probe_timeout = 300.0
         try:
-            tpu_budget = float(os.environ.get("ROKO_BENCH_TPU_BUDGET", "1380"))
+            tpu_budget = float(os.environ.get("ROKO_BENCH_TPU_BUDGET", "1500"))
         except ValueError:
-            tpu_budget = 1380.0
+            tpu_budget = 1500.0
 
         t0 = time.monotonic()
         ok, why = _probe_backend(probe_timeout, log)
