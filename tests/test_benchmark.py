@@ -171,6 +171,23 @@ def test_orchestrated_main_uses_child_result_when_probe_ok(
     assert json.loads(line) == child
 
 
+def test_orchestrated_main_last_resort_still_emits_json(capsys, monkeypatch):
+    """Even if the orchestration itself blows up, the artifact must be
+    one parseable JSON line with rc=0 — never a traceback (the failure
+    class that voided BENCH_r03)."""
+    monkeypatch.setenv("JAX_PLATFORMS", "axon")
+
+    def boom(t, log):
+        raise OSError("disk fell off")
+
+    monkeypatch.setattr(B, "_probe_backend", boom)
+    B.main([])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["metric"] == "polished_bases_per_sec_per_chip"
+    assert "disk fell off" in result["detail"]["fatal"]
+
+
 def test_wait_no_kill_abandons_without_killing():
     import subprocess
     import sys
