@@ -126,6 +126,18 @@ ExtractResult ExtractWindows(const std::string& bam_path,
   }
   if (reads.empty()) return result;
 
+  // generous output preallocation: growth reallocations re-copy the
+  // whole accumulated matrix (tens of MB per 100 kb region). Insertion
+  // columns can push the window count past span/stride, so reserve
+  // with slack — reserve is a hint, not a cap.
+  {
+    const size_t est_windows =
+        static_cast<size_t>((end - start) / cfg.stride + 2) * 5 / 4;
+    result.positions.reserve(2ul * cfg.cols * est_windows);
+    result.matrix.reserve(
+        static_cast<size_t>(cfg.rows) * cfg.cols * est_windows);
+  }
+
   const int slots = cfg.max_ins + 1;
   auto key_of = [slots](int64_t rpos, int ins) -> int64_t {
     return rpos * slots + ins;
@@ -257,15 +269,13 @@ ExtractResult ExtractWindows(const std::string& bam_path,
           result.positions[pos_base + 2 * c + 1] = key % slots;
         }
 
-        size_t mat_base = result.matrix.size();
-        result.matrix.resize(mat_base +
-                             static_cast<size_t>(cfg.rows) * cfg.cols);
+        // append row copies with insert (plain memcpy): resize would
+        // zero-fill 18 kB per window only to overwrite it — the r4
+        // profile put the sampling block at ~half of extraction time
         for (int r = 0; r < cfg.rows; ++r) {
           int rid = valid[rng.NextBelow(n_valid)];
           const std::vector<uint8_t>& row = rows_buf[rid_slot[rid]];
-          std::copy(row.begin(), row.end(),
-                    result.matrix.begin() + mat_base +
-                        static_cast<size_t>(r) * cfg.cols);
+          result.matrix.insert(result.matrix.end(), row.begin(), row.end());
         }
         result.n_windows += 1;
       }
