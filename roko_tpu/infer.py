@@ -122,6 +122,25 @@ class VoteBoard:
     # receive per window row.
     SAT_LIMIT = 65_000
 
+    # Headroom between SAT_LIMIT and the uint16 wrap. A prior check
+    # guarantees every slot is < SAT_LIMIT entering a scatter, so a wrap
+    # is impossible iff no single scatter adds more than this many votes
+    # to one (slot, class). Well-formed feeds add <=1 per row (<=512 per
+    # chunked call), but ``add`` is public — a malformed feed with
+    # duplicated (pos, ins) within a row could exceed the headroom, so
+    # the per-call increment is checked BEFORE the in-place uint16 add
+    # (ADVICE r4).
+    _WRAP_HEADROOM = 2**16 - SAT_LIMIT
+
+    def _check_increment(self, inc_max: int, contig: str) -> None:
+        if inc_max > self._WRAP_HEADROOM:
+            raise RuntimeError(
+                f"vote scatter on contig {contig!r} would add {inc_max} "
+                f"votes to one slot in a single call (> headroom "
+                f"{self._WRAP_HEADROOM}); the feed duplicates positions "
+                "within window rows — refusing to risk a uint16 wrap."
+            )
+
     def _check_saturation(self, touched_max: int, contig: str) -> None:
         if touched_max >= self.SAT_LIMIT:
             raise RuntimeError(
@@ -153,6 +172,11 @@ class VoteBoard:
         before the +536 headroom to the uint16 wrap can be consumed."""
         lo, hi = int(flat.min()), int(flat.max()) + 1
         if hi - lo > self._BINCOUNT_SPAN_CAP:
+            # exotic wide-span path: pay an O(n log n) unique to bound
+            # the per-slot increment before the wrapping np.add.at
+            comb = flat.astype(np.int64) * C.NUM_CLASSES + preds
+            _, mult = np.unique(comb.ravel(), return_counts=True)
+            self._check_increment(int(mult.max()), contig)
             np.add.at(board, (flat, preds), 1)
             self._check_saturation(int(board[flat, preds].max()), contig)
             return
@@ -160,6 +184,7 @@ class VoteBoard:
         counts = np.bincount(
             comb.ravel(), minlength=(hi - lo) * C.NUM_CLASSES
         ).reshape(-1, C.NUM_CLASSES)
+        self._check_increment(int(counts.max()), contig)
         region = board[lo:hi]
         region += counts.astype(np.uint16)
         self._check_saturation(int(region.max()), contig)

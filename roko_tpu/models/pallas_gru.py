@@ -95,18 +95,28 @@ def _pick_blocks(T: int, B: int, hidden: int, itemsize: int, bwd: bool):
 
 
 def _pick_tblk_v3(T: int, rows: int, hidden: int, itemsize: int,
-                  bwd: bool = False):
+                  n_dirs: int = 2, bwd: bool = False):
     """Largest divisor-of-T time block that fits the v3 (time-only
     grid) working set: double-buffered streams for ALL ``rows``
     (fwd: xp[3H]+out[H]; bwd: xp[3H]+h[H]+dy[H]+dxp[3H]+boundary slack)
-    plus the resident f32 carry scratch. Returns None when even
+    plus everything resident across grid steps — the f32 carry scratch,
+    the per-direction whh/bhh weight blocks (pinned whole-kernel by
+    their constant index maps), and in the backward the f32 dwhh/dbhh
+    output blocks with ~2 extra in-flight copies for the fori_loop
+    gradient-tuple carries (ADVICE r4: the old model omitted these and
+    could overshoot real VMEM at hidden>=256). Returns None when even
     t_blk=1 does not fit — the caller then falls back to the
     batch-blocked v2 grid (correct everywhere, serialises batch
     blocks)."""
     per_row = (9 if bwd else 4) * hidden * itemsize
-    scratch = rows * hidden * 4
+    wsize = n_dirs * (hidden + 1) * 3 * hidden  # whh [H,3H] + bhh [1,3H]
+    resident = rows * hidden * 4 + wsize * itemsize
+    if bwd:
+        # dwhh/dbhh f32 outputs + ~2 carry copies alive during the loop
+        # body (old tuple + updated tuple), and a second dh-sized carry
+        resident += 3 * wsize * 4 + rows * hidden * 4
     for t_blk in (d for d in range(T, 0, -1) if T % d == 0):
-        if 2 * t_blk * rows * per_row + scratch <= _VMEM_BUDGET:
+        if 2 * t_blk * rows * per_row + resident <= _VMEM_BUDGET:
             # t_blk=1 is DMA-per-step but still one 90-step serial
             # chain — far ahead of v2's S x nb passes at wide batch
             return t_blk
@@ -439,7 +449,7 @@ def _gru_multi_fwd(static, w_ih, b_ih, w_hh, b_hh, x):
     # shapes do): time-only serial grid, see _fwd_kernel_v3. v2
     # batch-blocked grid otherwise.
     Bp16 = _round_up(B, 16)
-    t3 = _pick_tblk_v3(T, S * Bp16, hidden, cdt.itemsize)
+    t3 = _pick_tblk_v3(T, S * Bp16, hidden, cdt.itemsize, n_dirs=S)
     if t3 is not None:
         Bp = Bp16
         xs = _xproj_stacked(static, w_ih, b_ih, x, Bp)
@@ -506,7 +516,7 @@ def _gru_multi_bwd(static, res, dys):
     # v3 when the whole S x B working set fits (same grid logic as the
     # forward: time is the only serial axis)
     Bp16 = _round_up(B, 16)
-    t3 = _pick_tblk_v3(T, S * Bp16, hidden, cdt.itemsize, bwd=True)
+    t3 = _pick_tblk_v3(T, S * Bp16, hidden, cdt.itemsize, n_dirs=S, bwd=True)
     if t3 is not None:
         return _gru_multi_bwd_v3(static, res, dys, t3)
 

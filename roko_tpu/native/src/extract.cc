@@ -217,7 +217,12 @@ ExtractResult ExtractWindows(const std::string& bam_path,
       size_t rows_used = 0;
 
       for (int c = 0; c < cfg.cols; ++c) {
-        const Codes& codes = code_pool[align_info[pos_queue[c]]];
+        // .at(): every queued position must already own a pool slot
+        // (enqueued together in the column sweep). operator[] would
+        // default-insert index 0 on a broken invariant and silently
+        // alias another column's codes; throwing is caught at the C-ABI
+        // boundary and surfaced as a distinct error code instead.
+        const Codes& codes = code_pool[align_info.at(pos_queue[c])];
         for (const auto& p : codes) {
           int32_t slot = rid_slot[p.first];
           if (slot == kNoSlot) {

@@ -46,6 +46,22 @@ def test_vote_saturation_aborts_instead_of_wrapping(threshold):
             _vote(b, "c", [(2, 1, G)])
 
 
+@pytest.mark.parametrize("span_cap", [None, 0], ids=["bincount", "add_at"])
+def test_malformed_duplicate_positions_refused(span_cap):
+    """ADVICE r4: ``add`` is public, and a malformed feed duplicating
+    one (pos, ins) across a row could add more than the 536-vote wrap
+    headroom in a single scatter — the per-call increment must be
+    checked BEFORE the in-place uint16 add, on both scatter paths."""
+    b = VoteBoard({"c": "AAAAAAAAAA"}, sparse_threshold=10**9)
+    if span_cap is not None:
+        b._BINCOUNT_SPAN_CAP = span_cap
+    bad = [(2, 0, Cc)] * 600  # one row, 600 identical (pos, ins)
+    with pytest.raises(RuntimeError, match="duplicates positions"):
+        _vote(b, "c", bad)
+    # well-formed rows with increments under the headroom still land
+    _vote(b, "c", [(2, 0, Cc), (3, 0, G)])
+
+
 def test_stitch_simple_replacement():
     draft = "AAAAAAAAAA"
     b = VoteBoard({"c": draft})
