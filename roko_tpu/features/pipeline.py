@@ -13,7 +13,9 @@ Workers pick the fastest available extractor backend (C++ via
 
 from __future__ import annotations
 
+import contextlib
 import multiprocessing
+import os
 from dataclasses import dataclass
 from typing import Iterator, List, Optional, Sequence, Tuple
 
@@ -188,10 +190,52 @@ def run_features(
     flush_every: int = 10,
     log=print,
 ) -> int:
-    """Generate a features HDF5. Returns the number of windows written."""
+    """Generate a features HDF5. Returns the number of windows written.
+
+    ``bam_x``/``bam_y`` may also be SAM text files (htslib reads either
+    transparently — models.cpp:37-44 — so the CLI contract matches):
+    they are converted once to temp coordinate-sorted BAM+BAI so the
+    native extractor and region fetches work identically. NB the
+    conversion sorts in memory — fine for the modest SAMs this is for;
+    genome-scale runs should hand over BAMs, which stream.
+    """
+    config = config or RokoConfig()
+    with contextlib.ExitStack() as stack:
+        bam_x = _ensure_bam(bam_x, stack)
+        if bam_y is not None:
+            bam_y = _ensure_bam(bam_y, stack)
+        return _run_features_on_bams(
+            ref_path, bam_x, out_path, bam_y, workers, seed, config,
+            flush_every, log,
+        )
+
+
+def _ensure_bam(path: str, stack) -> str:
+    """Pass BAMs through; convert SAM text to a temp sorted BAM+BAI."""
+    with open(path, "rb") as fh:
+        magic = fh.read(2)
+    if magic == b"\x1f\x8b":  # BGZF (BAM) — use as-is
+        return path
+    import tempfile
+
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.sam import SamReader
+
+    tmpdir = stack.enter_context(tempfile.TemporaryDirectory())
+    out = os.path.join(
+        tmpdir, os.path.basename(path).rsplit(".", 1)[0] + ".bam"
+    )
+    with SamReader(path) as r:
+        write_sorted_bam(out, r.references, list(r))
+    return out
+
+
+def _run_features_on_bams(
+    ref_path, bam_x, out_path, bam_y, workers, seed, config,
+    flush_every, log,
+) -> int:
     import time
 
-    config = config or RokoConfig()
     inference = bam_y is None
     refs = read_fasta(ref_path)
 

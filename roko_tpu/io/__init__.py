@@ -7,3 +7,4 @@ this package is the readable reference implementation and the test oracle.
 
 from roko_tpu.io.fasta import read_fasta, write_fasta  # noqa: F401
 from roko_tpu.io.bam import BamReader, BamRecord, BamWriter  # noqa: F401
+from roko_tpu.io.sam import SamError, SamReader  # noqa: F401

@@ -349,6 +349,14 @@ class BamReader:
                     beg, cend = struct.unpack_from("<QQ", data, off)
                     off += 16
                     chunks.append((beg, cend))
+                if bin_id == 37450:
+                    # samtools' metadata pseudo-bin (SAM spec §5.2): its
+                    # two "chunks" are (file range, mapped/unmapped
+                    # counts), NOT virtual offsets. reg2bins can never
+                    # return 37450 (real bins top out at 37448), but
+                    # storing it would still poison any future whole-bin
+                    # consumer — drop it explicitly.
+                    continue
                 bins[bin_id] = chunks
             n_intv = struct.unpack_from("<i", data, off)[0]
             off += 4
