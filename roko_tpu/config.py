@@ -29,6 +29,9 @@ class WindowConfig:
     cols: int = C.WINDOW_COLS
     stride: int = C.WINDOW_STRIDE
     max_ins: int = C.MAX_INS
+    #: first ref_rows rows carry the draft base per column (GAP at
+    #: insertion slots, forward-strand) — generate.cpp:109-119; the
+    #: reference compiles REF_ROWS=0 and so do we
     ref_rows: int = C.REF_ROWS
 
 

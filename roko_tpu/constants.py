@@ -93,7 +93,9 @@ CHAR_TO_CODE = {
     "C": 1, "c": 1,
     "G": 2, "g": 2,
     "T": 3, "t": 3,
-    "N": ENCODED_UNKNOWN, "-": ENCODED_UNKNOWN,
+    # lowercase n accepted too (soft-masked FASTAs) — the reference's
+    # get_base throws on it, a latent crash we choose not to reproduce
+    "N": ENCODED_UNKNOWN, "n": ENCODED_UNKNOWN, "-": ENCODED_UNKNOWN,
     "*": ENCODED_GAP,
 }
 

@@ -30,16 +30,22 @@ def extract_region_windows(
     seed: int,
     window_cfg: WindowConfig,
     filter_cfg: ReadFilterConfig,
+    ref_seq=None,
+    ref_seq_offset: int = 0,
 ) -> List[Window]:
     if _native_available():
         from roko_tpu.native import binding
 
         return binding.extract_windows(
-            bam_path, contig, start, end, seed, window_cfg, filter_cfg
+            bam_path, contig, start, end, seed, window_cfg, filter_cfg,
+            ref_seq=ref_seq, ref_seq_offset=ref_seq_offset,
         )
     with BamReader(bam_path) as reader:
         return list(
-            extract_windows(reader, contig, start, end, seed, window_cfg, filter_cfg)
+            extract_windows(
+                reader, contig, start, end, seed, window_cfg, filter_cfg,
+                ref_seq=ref_seq, ref_seq_offset=ref_seq_offset,
+            )
         )
 
 
@@ -51,6 +57,8 @@ def extract_region_arrays(
     seed: int,
     window_cfg: WindowConfig,
     filter_cfg: ReadFilterConfig,
+    ref_seq=None,
+    ref_seq_offset: int = 0,
 ):
     """Stacked form: (positions int64[N,cols,2], matrix uint8[N,rows,cols]).
     Preferred by the multiprocess pipeline — two contiguous buffers per
@@ -59,12 +67,14 @@ def extract_region_arrays(
         from roko_tpu.native import binding
 
         return binding.extract_windows_arrays(
-            bam_path, contig, start, end, seed, window_cfg, filter_cfg
+            bam_path, contig, start, end, seed, window_cfg, filter_cfg,
+            ref_seq=ref_seq, ref_seq_offset=ref_seq_offset,
         )
     import numpy as np
 
     windows = extract_region_windows(
-        bam_path, contig, start, end, seed, window_cfg, filter_cfg
+        bam_path, contig, start, end, seed, window_cfg, filter_cfg,
+        ref_seq=ref_seq, ref_seq_offset=ref_seq_offset,
     )
     if not windows:
         return (

@@ -51,6 +51,13 @@ def _encode_nibble_base(ch: str) -> int:
     return code
 
 
+def _encode_draft_base(ch: str) -> int:
+    code = C.CHAR_TO_CODE.get(ch)
+    if code is None:
+        raise ValueError(f"unexpected base {ch!r} in draft sequence")
+    return code
+
+
 def extract_windows(
     reader: BamReader,
     contig: str,
@@ -59,10 +66,34 @@ def extract_windows(
     seed: int,
     window_cfg: Optional[WindowConfig] = None,
     filter_cfg: Optional[ReadFilterConfig] = None,
+    ref_seq: Optional[str] = None,
+    ref_seq_offset: int = 0,
 ) -> Iterator[Window]:
-    """Yield feature windows for draft positions in ``[start, end)``."""
+    """Yield feature windows for draft positions in ``[start, end)``.
+
+    When ``window_cfg.ref_rows > 0`` the first ref_rows rows of every
+    window carry the DRAFT base per column — GAP at insertion slots,
+    forward-strand encoding (the reference's REF_ROWS block,
+    generate.cpp:109-119) — and ``ref_seq`` is required: the draft
+    contig starting at absolute position ``ref_seq_offset`` and covering
+    at least ``[start, end)``. The offset lets region workers receive
+    just their slice instead of the whole contig (per-job IPC stays
+    O(region), not O(contig)). The remaining rows are the usual sampled
+    reads.
+    """
     wcfg = window_cfg or WindowConfig()
     rows, cols, stride, max_ins = wcfg.rows, wcfg.cols, wcfg.stride, wcfg.max_ins
+    ref_rows = wcfg.ref_rows
+    if not 0 <= ref_rows <= rows:
+        raise ValueError("ref_rows must be in [0, rows]")
+    if ref_rows > 0 and (
+        ref_seq is None
+        or ref_seq_offset > start
+        or len(ref_seq) < end - ref_seq_offset
+    ):
+        raise ValueError(
+            "ref_rows > 0 needs the draft sequence covering [start, end)"
+        )
     rng = SplitMix64(seed)
 
     pos_queue: List[PosKey] = []
@@ -121,8 +152,16 @@ def extract_windows(
                 valid = sorted(valid_set)
                 n_valid = len(valid)
                 matrix = np.empty((rows, cols), dtype=np.uint8)
+                if ref_rows > 0:
+                    draft = [
+                        gap
+                        if ins != 0
+                        else _encode_draft_base(ref_seq[p - ref_seq_offset])
+                        for p, ins in window_keys
+                    ]
+                    matrix[:ref_rows] = np.array(draft, dtype=np.uint8)
                 row_cache: Dict[int, np.ndarray] = {}
-                for r in range(rows):
+                for r in range(ref_rows, rows):
                     rid = valid[rng.next_below(n_valid)]
                     row = row_cache.get(rid)
                     if row is None:

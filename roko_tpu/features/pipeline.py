@@ -56,6 +56,12 @@ class _Job:
     region: Region
     seed: int
     config: RokoConfig
+    # draft slice covering [region.start, region.end), shipped to
+    # workers only when config.window.ref_rows > 0 (the draft-base rows
+    # need it). A slice, not the contig: per-job IPC stays O(region)
+    # instead of O(contig) x regions.
+    ref_seq: Optional[str] = None
+    ref_seq_offset: int = 0
 
 
 def _is_in_region(pos: int, aligns: Sequence[L.TargetAlign]) -> bool:
@@ -83,6 +89,8 @@ def generate_infer(job: _Job):
         job.seed,
         job.config.window,
         job.config.read_filter,
+        ref_seq=job.ref_seq,
+        ref_seq_offset=job.ref_seq_offset,
     )
     return region.name, positions, examples, None
 
@@ -130,6 +138,8 @@ def generate_train(job: _Job):
             job.seed,
             job.config.window,
             job.config.read_filter,
+            ref_seq=job.ref_seq,
+            ref_seq_offset=job.ref_seq_offset,
         )
 
         for w in windows:
@@ -249,6 +259,12 @@ def _run_features_on_bams(
                     region=region,
                     seed=derive_region_seed(seed, name, region.start),
                     config=config,
+                    ref_seq=(
+                        seq[region.start : region.end]
+                        if config.window.ref_rows > 0
+                        else None
+                    ),
+                    ref_seq_offset=region.start,
                 )
             )
 

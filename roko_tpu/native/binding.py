@@ -63,6 +63,10 @@ def _load_locked() -> ctypes.CDLL:
         ctypes.c_int,
         ctypes.c_int,
         ctypes.c_int,
+        ctypes.c_char_p,  # ref_seq (NULL when ref_rows == 0)
+        ctypes.c_int64,   # ref_len
+        ctypes.c_int64,   # ref_off (absolute position of ref_seq[0])
+        ctypes.c_int,     # ref_rows
         ctypes.POINTER(_RokoResult),
     ]
     lib.roko_free_result.argtypes = [ctypes.POINTER(_RokoResult)]
@@ -76,7 +80,7 @@ def _load_locked() -> ctypes.CDLL:
         ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
-    if lib.roko_native_abi_version() != 1:
+    if lib.roko_native_abi_version() != 2:
         raise RuntimeError("native extractor ABI mismatch; rebuild")
     _lib = lib
     return lib
@@ -98,13 +102,20 @@ def extract_windows_arrays(
     seed: int,
     window_cfg: Optional[WindowConfig] = None,
     filter_cfg: Optional[ReadFilterConfig] = None,
+    ref_seq: Optional[str] = None,
+    ref_seq_offset: int = 0,
 ):
     """Stacked form: (positions int64[N,cols,2], matrix uint8[N,rows,cols]).
     The preferred interface — the multiprocess pipeline ships these two
     contiguous buffers per region across the worker boundary instead of
-    thousands of per-window arrays."""
+    thousands of per-window arrays. ``ref_seq`` (draft contig bytes from
+    absolute position ``ref_seq_offset``, covering at least
+    ``[start, end)``) is required when ``window_cfg.ref_rows > 0``."""
     wcfg = window_cfg or WindowConfig()
     fcfg = filter_cfg or ReadFilterConfig()
+    if wcfg.ref_rows > 0 and ref_seq is None:
+        raise ValueError("ref_rows > 0 requires ref_seq")
+    ref_b = ref_seq.encode() if (ref_seq and wcfg.ref_rows > 0) else None
     lib = _load()
     res = _RokoResult()
     rc = lib.roko_extract_windows(
@@ -120,6 +131,10 @@ def extract_windows_arrays(
         fcfg.min_mapq,
         fcfg.filter_flag,
         1 if fcfg.require_proper_pair else 0,
+        ref_b,
+        len(ref_b) if ref_b is not None else 0,
+        ref_seq_offset,
+        wcfg.ref_rows,
         ctypes.byref(res),
     )
     if rc != 0:
@@ -164,11 +179,14 @@ def extract_windows(
     seed: int,
     window_cfg: Optional[WindowConfig] = None,
     filter_cfg: Optional[ReadFilterConfig] = None,
+    ref_seq: Optional[str] = None,
+    ref_seq_offset: int = 0,
 ) -> List[Window]:
     """Native equivalent of roko_tpu.features.extract.extract_windows;
     bit-identical output (tests/test_native.py)."""
     pos, mat = extract_windows_arrays(
-        bam_path, contig, start, end, seed, window_cfg, filter_cfg
+        bam_path, contig, start, end, seed, window_cfg, filter_cfg,
+        ref_seq, ref_seq_offset,
     )
     return [
         Window(positions=pos[i], matrix=mat[i]) for i in range(pos.shape[0])

@@ -16,7 +16,8 @@ extern "C" {
 
 // Compile-time geometry/encoding constants, asserted against
 // roko_tpu/constants.py at binding load (single source of truth).
-int roko_native_abi_version() { return 1; }
+// v2: roko_extract_windows gained (ref_seq, ref_len, ref_rows).
+int roko_native_abi_version() { return 2; }
 
 struct RokoResult {
   int64_t n_windows;
@@ -27,23 +28,31 @@ struct RokoResult {
 const char* roko_last_error() { return g_last_error.c_str(); }
 
 // Returns 0 on success, nonzero on error (message via roko_last_error).
+// ref_seq/ref_len: draft contig bytes (starting at absolute position
+// ref_off) for the ref_rows draft-base rows; pass nullptr/0/0 when
+// ref_rows == 0.
 int roko_extract_windows(const char* bam_path, const char* contig,
                          int64_t start, int64_t end, uint64_t seed, int rows,
                          int cols, int stride, int max_ins, int min_mapq,
                          int filter_flag, int require_proper_pair,
-                         RokoResult* out) {
+                         const char* ref_seq, int64_t ref_len,
+                         int64_t ref_off, int ref_rows, RokoResult* out) {
   try {
     roko::ExtractConfig cfg;
     cfg.rows = rows;
     cfg.cols = cols;
     cfg.stride = stride;
     cfg.max_ins = max_ins;
+    cfg.ref_rows = ref_rows;
     cfg.min_mapq = min_mapq;
     cfg.filter_flag = static_cast<uint16_t>(filter_flag);
     cfg.require_proper_pair = require_proper_pair != 0;
 
-    roko::ExtractResult res =
-        roko::ExtractWindows(bam_path, contig, start, end, seed, cfg);
+    roko::ExtractResult res = roko::ExtractWindows(
+        bam_path, contig, start, end, seed, cfg,
+        ref_seq ? std::string(ref_seq, static_cast<size_t>(ref_len))
+                : std::string(),
+        ref_off);
 
     out->n_windows = res.n_windows;
     out->positions = nullptr;

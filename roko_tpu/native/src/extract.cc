@@ -83,6 +83,21 @@ std::vector<ColState> ColumnStates(const BamRecord& rec) {
   return states;
 }
 
+// Draft FASTA char -> encoded base, matching constants.py CHAR_TO_CODE
+// (same mapping as the reference's get_base: include/models.h:148-169).
+uint8_t EncodeRefChar(char ch) {
+  switch (ch) {
+    case 'A': case 'a': return 0;
+    case 'C': case 'c': return 1;
+    case 'G': case 'g': return 2;
+    case 'T': case 't': return 3;
+    case 'N': case 'n': case '-': return kUnknown;
+    case '*': return kGap;
+    default:
+      throw std::runtime_error("unexpected base in draft sequence");
+  }
+}
+
 bool PassesFilter(const BamRecord& rec, const ExtractConfig& cfg) {
   if (rec.flag & cfg.filter_flag) return false;
   if (cfg.require_proper_pair && (rec.flag & 0x1) && !(rec.flag & 0x2))
@@ -96,7 +111,15 @@ bool PassesFilter(const BamRecord& rec, const ExtractConfig& cfg) {
 ExtractResult ExtractWindows(const std::string& bam_path,
                              const std::string& contig, int64_t start,
                              int64_t end, uint64_t seed,
-                             const ExtractConfig& cfg) {
+                             const ExtractConfig& cfg,
+                             const std::string& ref_seq, int64_t ref_off) {
+  if (cfg.ref_rows < 0 || cfg.ref_rows > cfg.rows)
+    throw std::runtime_error("ref_rows must be in [0, rows]");
+  if (cfg.ref_rows > 0 &&
+      (ref_off > start ||
+       static_cast<int64_t>(ref_seq.size()) < end - ref_off))
+    throw std::runtime_error(
+        "ref_rows > 0 needs the draft sequence covering [start, end)");
   BamReader reader(bam_path);
   ExtractResult result;
 
@@ -208,6 +231,7 @@ ExtractResult ExtractWindows(const std::string& bam_path,
   std::vector<std::vector<uint8_t>> rows_buf;
   std::vector<bool> slot_valid;
   std::vector<int> valid;
+  std::vector<uint8_t> ref_row;  // per-window draft row (ref_rows > 0)
 
   auto emit_windows = [&]() {
     while (static_cast<int>(pos_queue.size()) >= cfg.cols) {
@@ -274,10 +298,27 @@ ExtractResult ExtractWindows(const std::string& bam_path,
           result.positions[pos_base + 2 * c + 1] = key % slots;
         }
 
+        // draft-base rows first (reference's REF_ROWS block,
+        // generate.cpp:109-119): GAP at insertion slots, draft base
+        // elsewhere, always forward-strand encoding
+        if (cfg.ref_rows > 0) {
+          ref_row.clear();
+          for (int c = 0; c < cfg.cols; ++c) {
+            int64_t key = pos_queue[c];
+            ref_row.push_back(
+                key % slots != 0
+                    ? kGap
+                    : EncodeRefChar(ref_seq[key / slots - ref_off]));
+          }
+          for (int r = 0; r < cfg.ref_rows; ++r)
+            result.matrix.insert(result.matrix.end(), ref_row.begin(),
+                                 ref_row.end());
+        }
+
         // append row copies with insert (plain memcpy): resize would
         // zero-fill 18 kB per window only to overwrite it — the r4
         // profile put the sampling block at ~half of extraction time
-        for (int r = 0; r < cfg.rows; ++r) {
+        for (int r = cfg.ref_rows; r < cfg.rows; ++r) {
           int rid = valid[rng.NextBelow(n_valid)];
           const std::vector<uint8_t>& row = rows_buf[rid_slot[rid]];
           result.matrix.insert(result.matrix.end(), row.begin(), row.end());

@@ -22,6 +22,10 @@ struct ExtractConfig {
   int cols = 90;
   int stride = 30;
   int max_ins = 3;
+  // first ref_rows rows carry the DRAFT base per column (GAP at
+  // insertion slots, forward-strand encoding) — the reference's
+  // REF_ROWS block (generate.cpp:109-119); needs ref_seq when > 0
+  int ref_rows = 0;
   int min_mapq = 10;
   uint16_t filter_flag = 0xF04;  // UNMAP|SECONDARY|QCFAIL|DUP|SUPPLEMENTARY
   bool require_proper_pair = true;
@@ -50,9 +54,14 @@ class SplitMix64 {
   uint64_t state_;
 };
 
+// ref_seq: draft contig bytes starting at absolute position ref_off and
+// covering at least [start, end); only read when cfg.ref_rows > 0. The
+// offset lets region callers pass just their slice (O(region) IPC).
 ExtractResult ExtractWindows(const std::string& bam_path,
                              const std::string& contig, int64_t start,
                              int64_t end, uint64_t seed,
-                             const ExtractConfig& cfg);
+                             const ExtractConfig& cfg,
+                             const std::string& ref_seq = std::string(),
+                             int64_t ref_off = 0);
 
 }  // namespace roko

@@ -17,10 +17,14 @@ if not native.is_available():  # pragma: no cover
     pytest.skip("native extractor not built", allow_module_level=True)
 
 
-def _python_windows(bam, contig, start, end, seed, wcfg=None, fcfg=None):
+def _python_windows(bam, contig, start, end, seed, wcfg=None, fcfg=None,
+                    ref_seq=None, ref_seq_offset=0):
     with BamReader(bam) as reader:
         return list(
-            extract_windows(reader, contig, start, end, seed, wcfg, fcfg)
+            extract_windows(
+                reader, contig, start, end, seed, wcfg, fcfg,
+                ref_seq=ref_seq, ref_seq_offset=ref_seq_offset,
+            )
         )
 
 
@@ -161,3 +165,77 @@ def test_native_cg_tag_ultralong_cigar(tmp_path):
     assert py_inline, "fixture produced no windows"
     _assert_same(py_inline, py_cg)
     _assert_same(py_inline, cc_cg)
+
+
+def test_native_matches_python_ref_rows(tmp_path):
+    """ref_rows=1: the draft-base row block (generate.cpp:109-119) must
+    be bit-identical between backends, and carry the draft base at base
+    columns / GAP at insertion slots with no strand offset."""
+    rng = random.Random(33)
+    ref = random_seq(rng, 4000)
+    reads = simulate_reads(rng, ref, 0, coverage=20)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+
+    wcfg = WindowConfig(ref_rows=1)
+    py = _python_windows(bam, "ctg", 0, len(ref), 5, wcfg, ref_seq=ref)
+    cc = native.extract_windows(
+        bam, "ctg", 0, len(ref), 5, wcfg, ref_seq=ref
+    )
+    assert py, "expected windows"
+    _assert_same(py, cc)
+
+    saw_ins = False
+    for w in py:
+        for c, (p, ins) in enumerate(w.positions):
+            want = (
+                C.ENCODED_GAP
+                if ins != 0
+                else C.CHAR_TO_CODE[ref[int(p)]]
+            )
+            assert w.matrix[0, c] == want
+            saw_ins = saw_ins or ins != 0
+    assert saw_ins, "fixture should include insertion columns"
+
+    # sampled rows shrink by ref_rows; RNG stream consumption matches
+    # the oracle exactly (asserted by _assert_same above)
+    assert py[0].matrix.shape[0] == wcfg.rows
+
+
+def test_ref_rows_requires_ref_seq(tmp_path):
+    rng = random.Random(34)
+    ref = random_seq(rng, 1000)
+    reads = simulate_reads(rng, ref, 0, coverage=10)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+    wcfg = WindowConfig(ref_rows=1)
+    with pytest.raises(ValueError, match="ref_seq"):
+        native.extract_windows(bam, "ctg", 0, len(ref), 5, wcfg)
+    with pytest.raises(ValueError, match="draft sequence"):
+        _python_windows(bam, "ctg", 0, len(ref), 5, wcfg)
+
+
+def test_ref_rows_slice_offset_equivalence(tmp_path):
+    """Full contig at offset 0 and a region slice at its offset must
+    produce identical windows in both backends (the pipeline ships
+    slices so per-job IPC stays O(region))."""
+    rng = random.Random(35)
+    ref = random_seq(rng, 5000)
+    reads = simulate_reads(rng, ref, 0, coverage=15)
+    bam = str(tmp_path / "r.bam")
+    write_sorted_bam(bam, [("ctg", len(ref))], reads)
+
+    wcfg = WindowConfig(ref_rows=2)
+    start, end = 1500, 3500
+    full_py = _python_windows(bam, "ctg", start, end, 7, wcfg, ref_seq=ref)
+    slice_py = _python_windows(
+        bam, "ctg", start, end, 7, wcfg,
+        ref_seq=ref[start:end], ref_seq_offset=start,
+    )
+    slice_cc = native.extract_windows(
+        bam, "ctg", start, end, 7, wcfg,
+        ref_seq=ref[start:end], ref_seq_offset=start,
+    )
+    assert full_py, "expected windows"
+    _assert_same(full_py, slice_py)
+    _assert_same(full_py, slice_cc)

@@ -77,6 +77,29 @@ def test_run_features_infer(synthetic):
     assert total == n
 
 
+def test_run_features_infer_ref_rows(synthetic):
+    """End-to-end ref_rows wiring: the pipeline ships the draft contig
+    to workers and every window's first row is the encoded draft."""
+    out = str(synthetic["tmp"] / "infer_rr.hdf5")
+    cfg = RokoConfig(window=WindowConfig(ref_rows=1))
+    n = run_features(
+        synthetic["fasta"], synthetic["bam_x"], out, seed=5, config=cfg
+    )
+    assert n > 0
+    draft = synthetic["draft"]
+    with h5py.File(out, "r") as fd:
+        for g in (g for g in fd if g != "contigs"):
+            ex = fd[g]["examples"][:]
+            pos = fd[g]["positions"][:]
+            for w in range(ex.shape[0]):
+                want = np.where(
+                    pos[w, :, 1] != 0,
+                    C.ENCODED_GAP,
+                    [C.CHAR_TO_CODE[draft[int(p)]] for p in pos[w, :, 0]],
+                )
+                np.testing.assert_array_equal(ex[w, 0], want)
+
+
 def test_pooled_reader_matches_fresh_and_recycles(synthetic):
     """SlabPool mode must deliver bit-identical batches (via copies,
     since pooled arrays die at release) and actually recycle buffers:
