@@ -2,7 +2,25 @@
 imports stay stable (the simulator graduated to ``roko_tpu.sim`` because
 the benchmark, the verify recipe, and examples/ use it too)."""
 
-from roko_tpu.sim import (  # noqa: F401
+def full_edit_distance(a: bytes, b: bytes) -> int:
+    """Textbook O(nm) unit-cost Levenshtein — the test suite's
+    independent ground truth for the evaluator. Deliberately shares no
+    code with roko_tpu.eval (anchors, bands, native aligner)."""
+    prev = list(range(len(b) + 1))
+    for i in range(1, len(a) + 1):
+        cur = [i] + [0] * len(b)
+        ai = a[i - 1]
+        for j in range(1, len(b) + 1):
+            cur[j] = min(
+                prev[j] + 1,
+                cur[j - 1] + 1,
+                prev[j - 1] + (ai != b[j - 1]),
+            )
+        prev = cur
+    return prev[-1]
+
+
+from roko_tpu.sim import (  # noqa: E402, F401
     BASES,
     align_to_ref,
     build_synthetic_project,

@@ -90,19 +90,7 @@ def test_oracle_band_edge_flag():
     assert r.dele == 4 and not r.hit_band_edge
 
 
-def _full_edit_distance(a: bytes, b: bytes) -> int:
-    """Textbook O(nm) Levenshtein, the independent ground truth."""
-    prev = list(range(len(b) + 1))
-    for i in range(1, len(a) + 1):
-        cur = [i] + [0] * len(b)
-        for j in range(1, len(b) + 1):
-            cur[j] = min(
-                prev[j] + 1,
-                cur[j - 1] + 1,
-                prev[j - 1] + (a[i - 1] != b[j - 1]),
-            )
-        prev = cur
-    return prev[-1]
+from tests.helpers import full_edit_distance as _full_edit_distance  # noqa: E402
 
 
 def test_band_growth_is_exact_at_any_starting_pad():

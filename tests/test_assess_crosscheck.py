@@ -1,0 +1,161 @@
+"""Independent cross-checks of the evaluator (VERDICT r4 weak #7).
+
+Every accuracy number in BASELINE.md is produced by ``assess_pair`` —
+the framework grading itself. These tests close the loop from outside:
+
+1. a hand-constructed truth/polished pair whose exact edit script is
+   KNOWN by construction must come back with exactly those per-class
+   counts (not merely a plausible decomposition);
+2. the --bed error intervals must land on exactly the constructed loci;
+3. on random pairs, total errors must equal the true Levenshtein
+   distance computed by an independent, textbook O(nm) DP written here
+   with no shared code with the evaluator (pomoxis-equivalent check).
+"""
+
+import math
+import random
+
+import pytest
+
+from tests.helpers import full_edit_distance
+from roko_tpu.eval.assess import assess_pair
+
+BASES = b"ACGT"
+
+
+def _random_seq(rng: random.Random, n: int) -> bytearray:
+    return bytearray(rng.choice(BASES) for _ in range(n))
+
+
+def _other_base(rng: random.Random, ch: int) -> int:
+    while True:
+        b = rng.choice(BASES)
+        if b != ch:
+            return b
+
+
+def _apply_known_edits(rng, truth, n_sub, n_del, n_ins, spacing=300):
+    """Return (polished, subs, dels, inss) with edits at well-separated
+    loci so every unit-cost-optimal alignment realises exactly this
+    script's per-class counts. Insertion bases are chosen to differ from
+    both neighbours, so an inserted base can't slide along a homopolymer
+    into an adjacent edit."""
+    edits = n_sub + n_del + n_ins
+    loci = [spacing * (i + 1) for i in range(edits)]
+    rng.shuffle(loci)
+    sub_loci = sorted(loci[:n_sub])
+
+    def slide_proof(p):
+        # a deleted base inside a repeat can slide to a co-optimal
+        # position; demand both neighbours differ so the locus is unique
+        while truth[p] == truth[p - 1] or truth[p] == truth[p + 1]:
+            p += 1
+        return p
+
+    del_loci = sorted(slide_proof(p) for p in loci[n_sub : n_sub + n_del])
+    ins_loci = sorted(loci[n_sub + n_del :])
+
+    polished = bytearray()
+    prev = 0
+    events = sorted(
+        [(p, "sub") for p in sub_loci]
+        + [(p, "del") for p in del_loci]
+        + [(p, "ins") for p in ins_loci]
+    )
+    for p, kind in events:
+        polished += truth[prev:p]
+        if kind == "sub":
+            polished.append(_other_base(rng, truth[p]))
+            prev = p + 1
+        elif kind == "del":
+            prev = p + 1  # truth base skipped in polished
+        else:  # ins: extra base BEFORE truth[p], != neighbours
+            while True:
+                b = rng.choice(BASES)
+                if b != truth[p] and b != truth[p - 1]:
+                    polished.append(b)
+                    break
+            prev = p
+    polished += truth[prev:]
+    return bytes(polished), sub_loci, del_loci, ins_loci
+
+
+def test_known_edit_script_exact_counts():
+    rng = random.Random(1234)
+    truth = bytes(_random_seq(rng, 9000))
+    polished, subs, dels, inss = _apply_known_edits(
+        rng, truth, n_sub=3, n_del=2, n_ins=2
+    )
+
+    a = assess_pair(truth, polished)
+    assert (a.sub, a.dele, a.ins) == (3, 2, 2)
+    assert a.errors == 7
+    assert a.match == len(truth) - a.sub - a.dele
+    assert a.truth_len == len(truth)
+    assert a.polished_len == len(truth) - 2 + 2
+    assert not a.reverse_complemented
+    assert a.qscore == pytest.approx(-10.0 * math.log10(7 / len(truth)))
+
+
+def test_bed_intervals_land_on_constructed_loci():
+    rng = random.Random(77)
+    truth = bytes(_random_seq(rng, 6000))
+    polished, subs, dels, inss = _apply_known_edits(
+        rng, truth, n_sub=2, n_del=2, n_ins=2
+    )
+
+    a = assess_pair(truth, polished, collect_errors=True)
+    assert a.error_intervals is not None
+    got = {}
+    for start, end, kind, count in a.error_intervals:
+        for pos in range(start, end):
+            got.setdefault(kind, set()).add(pos)
+        assert count >= 1
+
+    assert got.get("sub") == set(subs)
+    assert got.get("del") == set(dels)
+    # an insertion sits BETWEEN truth bases; the evaluator reports it at
+    # the truth position it precedes
+    assert got.get("ins") == set(inss)
+    total = sum(c for _, _, _, c in a.error_intervals)
+    assert total == a.errors == 6
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_total_errors_equal_true_edit_distance(seed):
+    """Random pairs at polishing-realistic error density: assess_pair's
+    total error count must equal the true Levenshtein distance. Class
+    split can legitimately differ between co-optimal alignments; the
+    TOTAL cannot."""
+    rng = random.Random(seed)
+    n = rng.randrange(400, 900)
+    truth = _random_seq(rng, n)
+    polished = bytearray(truth)
+    # scatter random edits at ~1% density, unconstrained placement
+    n_edits = max(3, n // 100)
+    expected_max = 0
+    for _ in range(n_edits):
+        p = rng.randrange(1, len(polished) - 1)
+        kind = rng.choice(["sub", "del", "ins"])
+        if kind == "sub":
+            polished[p] = _other_base(rng, polished[p])
+        elif kind == "del":
+            del polished[p]
+        else:
+            polished.insert(p, rng.choice(BASES))
+        expected_max += 1
+
+    dist = full_edit_distance(bytes(truth), bytes(polished))
+    assert dist <= expected_max
+    a = assess_pair(bytes(truth), bytes(polished))
+    assert a.errors == dist
+    assert a.match == len(truth) - a.sub - a.dele
+
+
+def test_identical_pair_is_perfect():
+    rng = random.Random(9)
+    truth = bytes(_random_seq(rng, 3000))
+    a = assess_pair(truth, truth)
+    assert (a.sub, a.dele, a.ins) == (0, 0, 0)
+    assert a.match == len(truth)
+    assert a.qscore == math.inf
