@@ -40,6 +40,11 @@ def main() -> int:
     ap.add_argument(
         "--error-model", choices=("uniform", "homopolymer"), default="uniform"
     )
+    # window stride knobs for the homopolymer-gap recipe (BASELINE.md):
+    # a finer TRAIN stride multiplies training windows from the same
+    # genomes; a finer INFER stride multiplies votes per draft position
+    ap.add_argument("--train-stride", type=int, default=None)
+    ap.add_argument("--infer-stride", type=int, default=None)
     args = ap.parse_args()
 
     from roko_tpu.cli import _honor_jax_platforms_env, main as cli
@@ -83,17 +88,25 @@ def main() -> int:
             if role.startswith("train")
             else os.path.join(wd, "val.hdf5")
         )
-        rc = cli([
+        cmd = [
             "features", p["draft_fasta"], p["reads_bam"], out,
             "--Y", p["truth_bam"], "--seed", str(10 + i),
-        ])
+        ]
+        # train species only: the val window set must stay fixed so
+        # val metrics are comparable across --train-stride settings
+        if args.train_stride is not None and role.startswith("train"):
+            cmd += ["--window-stride", str(args.train_stride)]
+        rc = cli(cmd)
         assert rc == 0
     test_p = projects["test"]
     infer_h5 = os.path.join(wd, "test_infer.hdf5")
-    rc = cli([
+    cmd = [
         "features", test_p["draft_fasta"], test_p["reads_bam"], infer_h5,
         "--seed", "99",
-    ])
+    ]
+    if args.infer_stride is not None:
+        cmd += ["--window-stride", str(args.infer_stride)]
+    rc = cli(cmd)
     assert rc == 0
 
     print(

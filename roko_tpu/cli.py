@@ -129,6 +129,8 @@ def cmd_features(args: argparse.Namespace) -> int:
         workers=args.t,
         seed=args.seed,
         config=_build_config(args),
+        job_retries=args.job_retries,
+        job_timeout=args.job_timeout,
     )
     print(f"wrote {n} windows to {args.o}")
     return 0
@@ -370,6 +372,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--Y", default=None, help="truth-to-draft BAM (training mode)")
     p.add_argument("--t", type=int, default=1, help="worker processes")
     p.add_argument("--seed", type=int, default=0, help="row-sampling RNG seed")
+    p.add_argument(
+        "--job-retries", type=int, default=1,
+        help="in-parent retries for a region job that raised",
+    )
+    p.add_argument(
+        "--job-timeout", type=float, default=None,
+        help="seconds to wait per region result before assuming the "
+        "worker died and finishing the remainder in the parent "
+        "(must exceed the slowest honest region)",
+    )
     _config_arg(p)
     _window_args(p)
     p.set_defaults(fn=cmd_features)

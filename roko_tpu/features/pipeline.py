@@ -199,6 +199,8 @@ def run_features(
     config: Optional[RokoConfig] = None,
     flush_every: int = 10,
     log=print,
+    job_retries: int = 1,
+    job_timeout: Optional[float] = None,
 ) -> int:
     """Generate a features HDF5. Returns the number of windows written.
 
@@ -216,7 +218,7 @@ def run_features(
             bam_y = _ensure_bam(bam_y, stack)
         return _run_features_on_bams(
             ref_path, bam_x, out_path, bam_y, workers, seed, config,
-            flush_every, log,
+            flush_every, log, job_retries, job_timeout,
         )
 
 
@@ -240,9 +242,85 @@ def _ensure_bam(path: str, stack) -> str:
     return out
 
 
+def _recovering_results(results, func, jobs, retries, timeout, log, pool=None):
+    """Failure detection/recovery for the region fan-out (SURVEY §5.3).
+
+    Region jobs are pure functions of (bam paths, region, seed), so a
+    failed or lost job is safely re-runnable with identical output. Two
+    failure classes are handled:
+
+    - a job that RAISES (worker exception propagates through imap/map):
+      re-run it in the parent up to ``retries`` times before giving up
+      and re-raising — transient faults (OOM-killed sibling, flaky
+      filesystem) don't abort an hours-long multi-species run;
+    - a job whose worker process DIED (``imap`` would block forever on
+      the lost result): when ``timeout`` is set and ``pool`` is a
+      process pool, each result wait is bounded; on a timeout the pool
+      is terminated and the remainder recomputed in the parent. Opt-in
+      because the bound must exceed the slowest honest region, and
+      process-pools only (threads cannot die out from under the queue).
+    """
+    import multiprocessing as mp
+
+    def rerun(job, err):
+        for attempt in range(retries):
+            log(
+                f"features: region {job.region.name}:{job.region.start} "
+                f"failed ({type(err).__name__}: {err}); "
+                f"retry {attempt + 1}/{retries} in the parent"
+            )
+            try:
+                return func(job)
+            except Exception as e2:  # noqa: PERF203 - retry loop
+                err = e2
+        raise err
+
+    it = iter(results)
+    can_timeout = (
+        timeout is not None and pool is not None and hasattr(it, "next")
+    )
+    broken = False
+    for i, job in enumerate(jobs):
+        if broken:
+            # pool results are untrustworthy after a lost-result event
+            # (any late arrival would mis-align with later jobs) —
+            # finish the remainder sequentially in the parent
+            try:
+                yield func(job)
+            except Exception as e:
+                yield rerun(job, e)
+            continue
+        try:
+            result = it.next(timeout) if can_timeout else next(it)
+        except StopIteration:  # pragma: no cover - defensive
+            raise RuntimeError(
+                f"result stream ended early at job {i}/{len(jobs)}"
+            ) from None
+        except mp.TimeoutError:
+            log(
+                f"features: region {job.region.name}:{job.region.start} "
+                f"result not ready after {timeout}s (worker died?); "
+                "abandoning the pool — remaining regions run in the parent"
+            )
+            broken = True
+            # kill the orphaned workers NOW: left running they would
+            # chew through every queued region in parallel with the
+            # parent's recompute, wasting cores and I/O for the whole
+            # recovery tail (results would be discarded anyway)
+            pool.terminate()
+            try:
+                yield func(job)
+            except Exception as e:
+                yield rerun(job, e)
+            continue
+        except Exception as e:
+            result = rerun(job, e)
+        yield result
+
+
 def _run_features_on_bams(
     ref_path, bam_x, out_path, bam_y, workers, seed, config,
-    flush_every, log,
+    flush_every, log, job_retries, job_timeout,
 ) -> int:
     import time
 
@@ -274,6 +352,7 @@ def _run_features_on_bams(
     with DataWriter(out_path, inference) as data:
         data.write_contigs(refs)
 
+        is_thread_pool = False
         if workers <= 1:
             results = map(func, jobs)
             pool = None
@@ -285,9 +364,18 @@ def _run_features_on_bams(
 
             pool = ThreadPool(processes=workers)
             results = pool.imap(func, jobs)
+            is_thread_pool = True
         else:
             pool = multiprocessing.Pool(processes=workers)
             results = pool.imap(func, jobs)
+        # job_timeout applies only to PROCESS pools: a thread cannot die
+        # out from under the queue (the failure class the timeout
+        # detects), and abandoning a ThreadPool would deadlock the
+        # close/join on any genuinely hung thread
+        results = _recovering_results(
+            results, func, jobs, job_retries, job_timeout, log,
+            pool=None if is_thread_pool else pool,
+        )
 
         t0 = time.perf_counter()
         try:
@@ -314,7 +402,16 @@ def _run_features_on_bams(
             data.write()
         finally:
             if pool is not None:
-                pool.close()
-                pool.join()
+                if is_thread_pool:
+                    # threads can't be killed; close/join is safe (no
+                    # thread can die out from under the queue)
+                    pool.close()
+                    pool.join()
+                else:
+                    # terminate, not close/join: after a lost-result
+                    # event the stream was deliberately abandoned, and a
+                    # hung (not dead) worker would block join forever
+                    pool.terminate()
+                    pool.join()
 
     return total
