@@ -216,13 +216,18 @@ def bench_torch_reference(iters: int = TORCH_ITERS, batch: int = 128) -> float:
 SWEEP_BATCHES = (BATCH, 2048)
 
 
-def run_inference_suite(batch: Optional[int] = None) -> Dict[str, Any]:
+def run_inference_suite(
+    batch: Optional[int] = None, progress=None
+) -> Dict[str, Any]:
     """Both device recurrence paths (lax.scan vs fused Pallas), on TPU
     across a small batch sweep (the serial recurrence amortises over
     batch rows, so wider batches raise windows/s until the MXU
     saturates). Honest: a per-path failure is recorded under
     ``batch_sweep.<batch>.{scan,pallas}_error``, never hidden, and all
-    per-path per-batch rates are reported so the headline is auditable."""
+    per-path per-batch rates are reported so the headline is auditable.
+    ``progress`` (if given) is called with the in-progress detail dict
+    after every measured path so an abandoned child leaves its completed
+    rows on disk (r5: the chip can stop answering MID-compile)."""
     import jax
 
     from roko_tpu.config import ModelConfig
@@ -238,22 +243,26 @@ def run_inference_suite(batch: Optional[int] = None) -> Dict[str, Any]:
     cfg = ModelConfig(compute_dtype="bfloat16")
     cfg_p = ModelConfig(compute_dtype="bfloat16", use_pallas=True)
     best, best_batch, sweep = 0.0, None, {}
+    detail["batch_sweep"] = sweep
     for b in batches:
         rates: Dict[str, Any] = {}
+        sweep[str(b)] = rates
         try:
             rates["scan"] = round(bench_infer(cfg, b), 1)
         except Exception as e:
             rates["scan_error"] = f"{type(e).__name__}: {e}"[:300]
+        if progress is not None:
+            progress(detail)
         if on_tpu:
             try:
                 rates["pallas"] = round(bench_infer(cfg_p, b), 1)
             except Exception as e:  # report, never swallow (VERDICT r2)
                 rates["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+            if progress is not None:
+                progress(detail)
         top = max(rates.get("scan", 0.0), rates.get("pallas", 0.0))
         if top > best:
             best, best_batch = top, b
-        sweep[str(b)] = rates
-    detail["batch_sweep"] = sweep
     if best == 0.0:
         raise RuntimeError(f"all inference paths failed: {sweep}")
     first = sweep[str(batches[0])]
@@ -272,13 +281,16 @@ def run_inference_suite(batch: Optional[int] = None) -> Dict[str, Any]:
 
 
 def run_train_suite(
-    batch: int = BATCH, budget_s: Optional[float] = None
+    batch: int = BATCH, budget_s: Optional[float] = None, progress=None
 ) -> Dict[str, Any]:
     """Fill the BASELINE.md 'measure & report' rows: flagship GRU train
     step (configs[1]), 4-layer/2x-hidden scan-depth stress (configs[3]),
     transformer variant (configs[4]). ``budget_s`` bounds wall time:
     suites that don't fit are reported as skipped, never hidden (the
-    driver's bench run has a deadline; fresh compiles dominate)."""
+    driver's bench run has a deadline; fresh compiles dominate).
+    ``progress`` (if given) is called with the in-progress suite dict
+    after every row so an abandoned child leaves completed rows on
+    disk."""
     from roko_tpu.config import ModelConfig
 
     import jax
@@ -328,23 +340,27 @@ def run_train_suite(
     for name, cfg in suites.items():
         if budget_s is not None and time.perf_counter() - t0 > budget_s:
             out[name] = {"error": f"skipped: {budget_s:.0f}s bench budget spent"}
-            continue
-        try:
-            r = bench_train(
-                cfg,
-                batch,
-                rng_impl="rbg" if name.endswith("_rbg") else "threefry",
-            )
-            r["windows_per_sec"] = round(r["windows_per_sec"], 1)
-            r["step_ms"] = round(r["step_ms"], 2)
-            if peak and cfg.kind == "gru":
-                flops = model_flops_per_window(cfg, training=True)
-                r["mfu_pct"] = round(
-                    100.0 * r["windows_per_sec"] * flops / peak, 2
+        else:
+            try:
+                r = bench_train(
+                    cfg,
+                    batch,
+                    rng_impl="rbg" if name.endswith("_rbg") else "threefry",
                 )
-            out[name] = r
-        except Exception as e:
-            out[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                r["windows_per_sec"] = round(r["windows_per_sec"], 1)
+                r["step_ms"] = round(r["step_ms"], 2)
+                if peak and cfg.kind == "gru":
+                    flops = model_flops_per_window(cfg, training=True)
+                    r["mfu_pct"] = round(
+                        100.0 * r["windows_per_sec"] * flops / peak, 2
+                    )
+                out[name] = r
+            except Exception as e:
+                out[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        # skipped rows flush too: a salvaged partial must show what was
+        # skipped, not silently omit it (r5 review)
+        if progress is not None:
+            progress(out)
     return out
 
 
@@ -438,8 +454,7 @@ def _measure(args) -> Dict[str, Any]:
     letting a sick backend turn the round's artifact into a traceback
     (VERDICT r3: BENCH_r03.json rc=1, parsed null)."""
     import os
-
-    from roko_tpu import constants as C
+    import sys
 
     # parse the env knob BEFORE any measurement so a typo can't discard
     # minutes of completed TPU work on a late ValueError
@@ -448,20 +463,70 @@ def _measure(args) -> Dict[str, Any]:
     except ValueError:
         train_budget = 480.0
 
-    detail = run_inference_suite(args.batch)
+    # stderr progress stamps: the orchestrated parent captures the child
+    # log, so a timed-out/abandoned child's tail shows which suite ate
+    # the budget instead of a bare platform warning (r5 post-mortem aid)
+    t_start = time.perf_counter()
+
+    def _stamp(suite: str) -> None:
+        print(
+            f"[bench] +{time.perf_counter() - t_start:7.1f}s {suite}",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    # partial-result flush: every completed measurement is written
+    # (atomically) to --out as {"partial": true, "detail": ...} and the
+    # final result overwrites it. If this process is later abandoned
+    # mid-suite — the r5 failure mode is a chip that stops answering
+    # mid-COMPILE, unkillable-safe but unfinishable — the orchestrating
+    # parent recovers the completed rows instead of discarding the whole
+    # TPU session (r3/r4 shipped zero TPU evidence for exactly this).
+    running_detail: Dict[str, Any] = {}
+
+    def _flush_partial(fragment_key=None, fragment=None):
+        if fragment_key is not None:
+            running_detail[fragment_key] = fragment
+        if not getattr(args, "out", None):
+            return
+        tmp = args.out + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"partial": True, "detail": running_detail}, f)
+            os.replace(tmp, args.out)
+        except OSError:
+            pass
+
+    def _merge_flush(d):
+        # inference-suite fields live at detail's top level in the final
+        # layout; mirror that in the partial so recovery needs no remap
+        running_detail.update(json.loads(json.dumps(d)))
+        _flush_partial()
+
+    _stamp("inference suite (batch sweep)")
+    detail = run_inference_suite(args.batch, progress=_merge_flush)
+    running_detail.update(detail)
+    _flush_partial()
     # the driver's end-of-round run invokes plain `python bench.py`; on
     # TPU, spend a bounded extra budget capturing the train step-times
     # BASELINE.md needs (ROKO_BENCH_TRAIN_BUDGET=0 disables)
     import jax
 
+    train_progress = lambda d: _flush_partial("train", dict(d))  # noqa: E731
     if args.train:
-        detail["train"] = run_train_suite(args.batch or BATCH)
-    elif jax.default_backend() == "tpu" and train_budget > 0:
+        _stamp("train suite (unbounded)")
         detail["train"] = run_train_suite(
-            args.batch or BATCH, budget_s=train_budget
+            args.batch or BATCH, progress=train_progress
+        )
+    elif jax.default_backend() == "tpu" and train_budget > 0:
+        _stamp(f"train suite (budget {train_budget:.0f}s)")
+        detail["train"] = run_train_suite(
+            args.batch or BATCH, budget_s=train_budget, progress=train_progress
         )
     if args.features:
+        _stamp("features suite")
         detail["features"] = run_features_suite()
+        _flush_partial("features", detail["features"])
     e2e_draft = getattr(args, "e2e_draft", None)
     if e2e_draft is None:
         # default scale by backend: a real slice on the chip, a token
@@ -469,12 +534,14 @@ def _measure(args) -> Dict[str, Any]:
         # disables entirely
         e2e_draft = 2_000_000 if jax.default_backend() == "tpu" else 60_000
     if e2e_draft:
+        _stamp(f"end-to-end suite (draft {e2e_draft})")
         try:
             detail["end_to_end"] = run_e2e_suite(e2e_draft)
         except Exception as e:  # report, never swallow
             detail["end_to_end"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("end_to_end", detail["end_to_end"])
+    _stamp("torch reference")
     ref_windows_per_sec = bench_torch_reference()
-    detail["torch_cpu_ref_windows_per_sec"] = round(ref_windows_per_sec, 1)
     # provenance: which stack produced this artifact (BENCH_r{N}.json is
     # compared across rounds; backend/device drift must be visible)
     detail["env"] = {
@@ -483,6 +550,18 @@ def _measure(args) -> Dict[str, Any]:
         "jax": jax.__version__,
         "git": _git_rev(),
     }
+    return _assemble_result(detail, ref_windows_per_sec)
+
+
+def _assemble_result(
+    detail: Dict[str, Any], ref_windows_per_sec: float
+) -> Dict[str, Any]:
+    """The one place the driver artifact's top-level shape is built —
+    shared by the full in-process run and the partial-salvage path so
+    the two can never drift (r5 review)."""
+    from roko_tpu import constants as C
+
+    detail["torch_cpu_ref_windows_per_sec"] = round(ref_windows_per_sec, 1)
     windows_per_sec = detail["windows_per_sec"]
     return {
         "metric": "polished_bases_per_sec_per_chip",
@@ -569,14 +648,26 @@ def _spawn_logged(cmd, budget_s: float, **popen_kw):
 
 
 def _probe_backend(timeout_s: float, log) -> tuple:
-    """Can a fresh process initialize the JAX backend at all?  Runs
-    ``jax.devices()`` in a subprocess so a wedged TPU relay hangs the
-    probe, not the artifact path. Returns (ok, reason)."""
+    """Can a fresh process initialize the JAX backend AND compile?  Runs
+    in a subprocess so a wedged TPU relay hangs the probe, not the
+    artifact path. The tiny jit canary matters: r5 observed a failure
+    mode where ``jax.devices()`` answers but the first XLA compile
+    blocks forever (far side of the relay dead mid-session) — a
+    devices-only probe waves the bench child into that tar pit and the
+    whole TPU budget burns with zero rows measured. A canary hang
+    instead surfaces here as DEVICES_OK-without-PROBE_OK inside
+    ``timeout_s``, and the artifact falls back to CPU with that
+    diagnostic in ``tpu_error``. Returns (ok, reason)."""
     import sys
 
     code = (
         "import jax\n"
+        "import jax.numpy as jnp\n"
         "d = jax.devices()\n"
+        "print('DEVICES_OK', d[0].platform, flush=True)\n"
+        "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+        "y = jax.jit(lambda a, b: (a @ b).sum())(x, x)\n"
+        "assert float(y) != 0.0\n"
         "print('PROBE_OK', d[0].platform, getattr(d[0], 'device_kind', '?'),"
         " flush=True)\n"
     )
@@ -585,18 +676,22 @@ def _probe_backend(timeout_s: float, log) -> tuple:
         return False, (
             f"backend probe still hung after {timeout_s:.0f}s "
             f"(relay wedged?); probe abandoned, not killed. tail: {out[-300:]}"
-        )
+        ), None
     if rc != 0 or "PROBE_OK" not in out:
-        return False, f"backend probe rc={rc}: {out[-400:]}"
-    log(f"[bench] backend probe ok: {out.strip().splitlines()[-1]}")
-    return True, ""
+        return False, f"backend probe rc={rc}: {out[-400:]}", None
+    ok_line = [l for l in out.strip().splitlines() if "PROBE_OK" in l][-1]
+    platform = ok_line.split()[1] if len(ok_line.split()) > 1 else "unknown"
+    log(f"[bench] backend probe ok: {ok_line}")
+    return True, "", platform
 
 
-def _run_child_bench(args, budget_s: float, log):
+def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
     """Run the full measurement in a child process (same env, live
     backend) with a wall-clock budget, so a mid-suite relay death can at
     worst cost the budget — never the artifact. Returns the child's
-    result dict, or None."""
+    result dict, or None. ``platform`` is the backend the probe actually
+    saw — threaded into any salvaged partial so a CPU measurement can
+    never be labelled as a chip one (r5 review)."""
     import os
     import sys
     import tempfile
@@ -618,16 +713,64 @@ def _run_child_bench(args, budget_s: float, log):
         try:
             with open(out_json) as f:
                 result = json.load(f)
-            os.unlink(out_json)
-            return result
+            if not result.get("partial"):
+                os.unlink(out_json)
+                return result
+            # leave the file in place: _recover_partial re-reads it
+            log("[bench] child rc=0 but left only a partial result")
         except (OSError, ValueError) as e:
             log(f"[bench] child rc=0 but result unreadable: {e}")
             return None
-    log(
-        f"[bench] TPU child {'timed out (abandoned)' if rc is None else f'rc={rc}'};"
-        f" log tail:\n{out[-1500:]}"
+    how = "timed out (abandoned)" if rc is None else f"rc={rc}"
+    log(f"[bench] TPU child {how}; log tail:\n{out[-1500:]}")
+    # The child flushes every completed measurement to --out as it goes
+    # (see _measure._flush_partial). Salvage whatever the chip answered
+    # before going dark: a partial TPU artifact with real sweep rows
+    # beats a complete CPU fallback (r3/r4 lesson — the headline is a
+    # TPU number or it is nothing).
+    return _recover_partial(out_json, how, log, platform)
+
+
+def _recover_partial(out_json: str, how: str, log, platform: str = "tpu"):
+    """Build a full driver result from an abandoned child's partial
+    flush, if it contains at least one successful inference rate."""
+    import os
+
+    try:
+        with open(out_json) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not raw.get("partial"):
+        return None
+    detail = raw.get("detail") or {}
+    sweep = detail.get("batch_sweep") or {}
+    rates = [
+        (max(r.get("scan", 0.0), r.get("pallas", 0.0)), int(b))
+        for b, r in sweep.items()
+    ]
+    best, best_batch = max(rates, default=(0.0, None))
+    if not best:
+        log("[bench] partial result had no completed inference row")
+        return None
+    try:
+        os.unlink(out_json)
+    except OSError:
+        pass
+    detail["windows_per_sec"] = detail.get("windows_per_sec", best) or best
+    detail.setdefault("best_batch", best_batch)
+    # env was never written (it is stamped at the end of a full run);
+    # the child only measures on the backend the probe cleared, so
+    # backend is known — but mark the artifact loudly as partial
+    detail.setdefault("env", {})
+    detail["env"].setdefault("backend", platform)
+    detail["env"].setdefault("git", _git_rev())
+    detail["partial"] = (
+        f"child {how} mid-suite; completed measurements salvaged from "
+        "the incremental flush, remaining suites missing"
     )
-    return None
+    log(f"[bench] salvaged partial TPU result: {best:.1f} windows/s")
+    return _assemble_result(detail, bench_torch_reference())
 
 
 def _force_cpu_backend() -> None:
@@ -788,10 +931,13 @@ def main(argv=None) -> None:
             tpu_budget = 1500.0
 
         t0 = time.monotonic()
-        ok, why = _probe_backend(probe_timeout, log)
+        ok, why, platform = _probe_backend(probe_timeout, log)
         if ok:
             result = _run_child_bench(
-                args, max(60.0, tpu_budget - (time.monotonic() - t0)), log
+                args,
+                max(60.0, tpu_budget - (time.monotonic() - t0)),
+                log,
+                platform=platform or "unknown",
             )
             if result is not None:
                 _emit(result, args.out)

@@ -371,7 +371,13 @@ def _run_features_on_bams(
         # job_timeout applies only to PROCESS pools: a thread cannot die
         # out from under the queue (the failure class the timeout
         # detects), and abandoning a ThreadPool would deadlock the
-        # close/join on any genuinely hung thread
+        # close/join on any genuinely hung thread — say so rather than
+        # silently ignoring an explicit flag (r5 review)
+        if job_timeout is not None and (is_thread_pool or pool is None):
+            log(
+                "--job-timeout applies only to process pools; ignored on "
+                + ("the thread-pool path" if is_thread_pool else "serial runs")
+            )
         results = _recovering_results(
             results, func, jobs, job_retries, job_timeout, log,
             pool=None if is_thread_pool else pool,

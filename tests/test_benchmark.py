@@ -125,7 +125,7 @@ def test_orchestrated_main_falls_back_to_cpu_on_dead_backend(
     monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")
     monkeypatch.setenv("ROKO_BENCH_TRAIN_BUDGET", "0")
     monkeypatch.setattr(
-        B, "_probe_backend", lambda t, log: (False, "simulated wedge")
+        B, "_probe_backend", lambda t, log: (False, "simulated wedge", None)
     )
     # the real _measure is exercised by test_bench_json_contract; here a
     # canned result keeps the orchestration-wiring assertion fast. It
@@ -164,8 +164,8 @@ def test_orchestrated_main_uses_child_result_when_probe_ok(
         "vs_baseline": 9.0,
         "detail": {"env": {"backend": "tpu"}},
     }
-    monkeypatch.setattr(B, "_probe_backend", lambda t, log: (True, ""))
-    monkeypatch.setattr(B, "_run_child_bench", lambda a, b, log: child)
+    monkeypatch.setattr(B, "_probe_backend", lambda t, log: (True, "", "tpu"))
+    monkeypatch.setattr(B, "_run_child_bench", lambda a, b, log, platform="tpu": child)
     B.main([])
     line = capsys.readouterr().out.strip().splitlines()[-1]
     assert json.loads(line) == child
@@ -202,6 +202,109 @@ def test_wait_no_kill_abandons_without_killing():
     assert proc.poll() is None  # still running — never killed
     assert proc.wait(timeout=30) == 0  # dies on its own, cleanly
     assert _time.monotonic() - t0 < 30
+
+
+def _fake_spawn_writing(partial):
+    def fake_spawn(cmd, budget_s, **kw):
+        out = cmd[cmd.index("--out") + 1]
+        with open(out, "w") as f:
+            json.dump(partial, f)
+        return None, "child stuck in compile"
+
+    return fake_spawn
+
+
+def test_child_bench_salvages_partial_on_abandon(monkeypatch):
+    """An abandoned TPU child leaves its incremental flush behind; the
+    orchestrator must recover completed rows into a full driver result
+    (r5: the chip stopped answering mid-compile, and without salvage
+    every measured row would have been discarded for a CPU fallback)."""
+    import argparse
+
+    from roko_tpu import constants as C
+
+    partial = {
+        "partial": True,
+        "detail": {
+            "batch": 512,
+            "batch_sweep": {"512": {"scan": 70000.0, "pallas": 74000.0}},
+            "train": {"train_gru": {"step_ms": 170.0}},
+        },
+    }
+    monkeypatch.setattr(B, "_spawn_logged", _fake_spawn_writing(partial))
+    monkeypatch.setattr(B, "bench_torch_reference", lambda: 100.0)
+    args = argparse.Namespace(
+        train=False, features=False, batch=None, e2e_draft=None
+    )
+    res = B._run_child_bench(args, 10.0, lambda m: None)
+    assert res is not None
+    assert res["value"] == 74000.0 * C.WINDOW_STRIDE
+    assert res["vs_baseline"] == 740.0
+    d = res["detail"]
+    assert d["env"]["backend"] == "tpu"
+    assert "partial" in d and "salvaged" in d["partial"]
+    assert d["train"]["train_gru"]["step_ms"] == 170.0
+    assert d["best_batch"] == 512
+
+    # the salvage labels the artifact with the PROBED platform — a CPU
+    # probe must never produce a salvaged artifact claiming "tpu"
+    monkeypatch.setattr(B, "_spawn_logged", _fake_spawn_writing(partial))
+    res_cpu = B._run_child_bench(args, 10.0, lambda m: None, platform="cpu")
+    assert res_cpu["detail"]["env"]["backend"] == "cpu"
+
+
+def test_child_bench_no_salvage_without_inference_row(monkeypatch):
+    """A partial flush with zero completed inference rates cannot make a
+    headline; the orchestrator must fall through to the CPU fallback."""
+    import argparse
+
+    partial = {
+        "partial": True,
+        "detail": {"batch_sweep": {"512": {"scan_error": "hung"}}},
+    }
+    monkeypatch.setattr(B, "_spawn_logged", _fake_spawn_writing(partial))
+    args = argparse.Namespace(
+        train=False, features=False, batch=None, e2e_draft=None
+    )
+    assert B._run_child_bench(args, 10.0, lambda m: None) is None
+
+
+def test_measure_flushes_partials_incrementally(monkeypatch, tmp_path):
+    """The in-process measurement writes {"partial": true, ...} to
+    --out after every completed unit — proven by dying LATE (at the
+    torch-reference stage) and finding the inference rows already on
+    disk — and a completed run's final emit overwrites the partial."""
+    import argparse
+
+    import pytest
+
+    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=None: 10.0)
+
+    def boom():
+        raise RuntimeError("torch ref exploded")
+
+    monkeypatch.setattr(B, "bench_torch_reference", boom)
+    monkeypatch.setenv("ROKO_BENCH_TRAIN_BUDGET", "0")
+    args = argparse.Namespace(
+        train=False,
+        features=False,
+        batch=8,
+        e2e_draft=0,
+        out=str(tmp_path / "bench.json"),
+    )
+    with pytest.raises(RuntimeError, match="torch ref exploded"):
+        B._measure(args)
+    part = json.loads((tmp_path / "bench.json").read_text())
+    assert part["partial"] is True
+    assert part["detail"]["batch_sweep"]["8"]["scan"] == 10.0
+
+    # healthy path: the final artifact replaces the partial
+    monkeypatch.setattr(B, "bench_torch_reference", lambda: 5.0)
+    result = B._measure(args)
+    B._emit(result, args.out)
+    final = json.loads((tmp_path / "bench.json").read_text())
+    assert "partial" not in final
+    assert final["value"] > 0
 
 
 def test_inference_suite_raises_when_all_paths_fail(monkeypatch):
