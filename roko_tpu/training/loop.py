@@ -184,6 +184,30 @@ def evaluate(eval_step, params, dataset, batch_size, mesh) -> Tuple[float, float
     return correct / max(total, 1.0), loss_sum / max(rows, 1.0)
 
 
+def _warn_if_cpu_mesh_oversubscribed(mesh: Mesh, log) -> None:
+    """A virtual CPU mesh wider than the physical core count is a
+    correctness hazard, not just slow: XLA CPU collectives require every
+    participant's thread to reach the rendezvous within ~40s, and when
+    the per-device shard computation itself takes tens of seconds the
+    devices execute serially on the contended cores, so device 0 can
+    wait out the timeout before device N-1 even starts — rendezvous.cc
+    then F-aborts the process ("Expected N threads to join ... not all
+    of them arrived"). Observed in r5 with the full model at dp=8 on a
+    1-core host; tiny-model tests never trip it. Warn loudly so the
+    user reaches for --dp 1 before the crash does."""
+    import os
+
+    n_mesh = int(np.prod(list(mesh.shape.values())))
+    cores = os.cpu_count() or 1
+    if n_mesh > 1 and cores < n_mesh and mesh.devices.flat[0].platform == "cpu":
+        log(
+            f"WARNING: {n_mesh}-device CPU mesh on {cores} core(s) — XLA "
+            "CPU collectives can hit their rendezvous timeout and abort "
+            "when per-device compute is heavy; use --dp 1 (or fewer "
+            "devices than cores) for full-size models on small hosts"
+        )
+
+
 def train(
     cfg: RokoConfig,
     train_path: str,
@@ -222,6 +246,7 @@ def train(
         raise ValueError(
             f"batch_size {tcfg.batch_size} not divisible by dp={dp}"
         )
+    _warn_if_cpu_mesh_oversubscribed(mesh, log)
 
     if tcfg.in_memory:
         train_ds = InMemoryDataset.from_path(train_path)

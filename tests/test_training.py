@@ -150,6 +150,33 @@ def test_evaluate_padding_unbiased(rng):
     assert acc_full == pytest.approx(acc_ragged, abs=1e-6)
 
 
+def test_cpu_mesh_oversubscription_warning(monkeypatch):
+    """An 8-device CPU mesh on fewer physical cores must warn (XLA CPU
+    collective rendezvous can abort when per-device compute is heavy —
+    observed r5 with the full model at dp=8 on a 1-core host)."""
+    import os
+
+    from roko_tpu.training.loop import _warn_if_cpu_mesh_oversubscribed
+
+    mesh = make_mesh(MeshConfig(dp=8))
+    logs = []
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    _warn_if_cpu_mesh_oversubscribed(mesh, logs.append)
+    assert logs and "rendezvous" in logs[0]
+
+    monkeypatch.setattr(os, "cpu_count", lambda: 64)
+    logs2 = []
+    _warn_if_cpu_mesh_oversubscribed(mesh, logs2.append)
+    assert not logs2
+
+    # a single-device mesh never warns, even on one core
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    logs3 = []
+    one = make_mesh(MeshConfig(dp=1), jax.devices()[:1])
+    _warn_if_cpu_mesh_oversubscribed(one, logs3.append)
+    assert not logs3
+
+
 def test_train_resume_from_checkpoint(rng, tmp_path):
     """An interrupted run restarts from its latest checkpoint instead of
     from scratch (SURVEY §5.3 build note — the reference had no resume)."""
