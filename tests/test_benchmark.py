@@ -60,6 +60,22 @@ def test_perf_probe_tool_parses():
     assert r.returncode == 0 and "--quick" in r.stdout
 
 
+def test_chip_probe_tool_parses():
+    """tools/chip_probe.py must import and parse args (it can only
+    meaningfully RUN against live hardware)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "tools/chip_probe.py", "--help"],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=60,
+    )
+    assert r.returncode == 0 and "--timeout" in r.stdout
+
+
 def test_train_suite_budget_reports_skips():
     out = B.run_train_suite(batch=2, budget_s=0.0)
     skipped = [v for v in out.values() if isinstance(v, dict) and "error" in v]

@@ -1,0 +1,42 @@
+"""Bounded TPU health probe: device init + a tiny jit canary.
+
+One line of output, never hangs the caller, never kills the probe
+child (abandoning is the only safe failure handling against the axon
+relay — see .claude/skills/verify gotchas). Exit code 0 = chip is
+usable for compiles, 1 = not.
+
+    python tools/chip_probe.py [--timeout 240]
+
+The canary matters: r5 observed a failure mode where ``jax.devices()``
+answers but the first XLA compile never returns; a devices-only probe
+would call that chip healthy and a full bench budget would burn on it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=float, default=240.0)
+    args = ap.parse_args()
+
+    from roko_tpu.benchmark import _probe_backend
+
+    ok, why, platform = _probe_backend(
+        args.timeout, lambda m: print(m, file=sys.stderr, flush=True)
+    )
+    if ok:
+        print(f"CHIP_OK platform={platform}")
+        return 0
+    print(f"CHIP_DOWN {why[:300]}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
