@@ -30,6 +30,12 @@ def main() -> int:
     ap.add_argument("--workdir", default="/tmp/roko_tpu_example")
     ap.add_argument("--genome-len", type=int, default=12_000)
     ap.add_argument("--epochs", type=int, default=25)
+    ap.add_argument(
+        "--coverage", type=int, default=30,
+        help="simulated read depth; deeper pileups give the model more "
+        "evidence per column (the homopolymer length-call lever, "
+        "BASELINE.md r5)",
+    )
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--dp", type=int, default=-1)
     ap.add_argument(
@@ -54,7 +60,9 @@ def main() -> int:
     if args.error_model == "homopolymer":
         hp = {"hp_indel_bias": 3.0, "hp_extend": 0.45}
     print(f"== building synthetic project in {wd} ({args.error_model} errors)")
-    paths = build_synthetic_project(wd, genome_len=args.genome_len, **hp)
+    paths = build_synthetic_project(
+        wd, genome_len=args.genome_len, coverage=args.coverage, **hp
+    )
 
     print("== stage 1: features (training mode, with truth labels)")
     train_h5 = os.path.join(wd, "train.hdf5")
