@@ -32,6 +32,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--workdir", default="/tmp/roko_tpu_multispecies")
     ap.add_argument("--genome-len", type=int, default=8_000)
+    ap.add_argument(
+        "--coverage", type=int, default=30,
+        help="simulated read depth per species (deeper pileups are the "
+        "homopolymer length-call lever, BASELINE.md r5)",
+    )
     ap.add_argument("--train-species", type=int, default=5)
     ap.add_argument("--epochs", type=int, default=60)
     ap.add_argument("--lr", type=float, default=3e-3)
@@ -74,6 +79,7 @@ def main() -> int:
             seed=1000 + i,
             genome_len=args.genome_len,
             contig=f"ctg_{role}",
+            coverage=args.coverage,
             **hp,
         )
         print(f"== species {role}: {sp_dir}")
