@@ -490,17 +490,23 @@ def _measure(args) -> Dict[str, Any]:
         if not getattr(args, "out", None):
             return
         tmp = args.out + ".tmp"
+        # TypeError/ValueError too: a non-serializable or circular row
+        # must degrade to a skipped flush, not abort the measurement run
+        # mid-suite (ADVICE — the flush is best-effort by design)
         try:
             with open(tmp, "w") as f:
                 json.dump({"partial": True, "detail": running_detail}, f)
             os.replace(tmp, args.out)
-        except OSError:
+        except (OSError, TypeError, ValueError):
             pass
 
     def _merge_flush(d):
         # inference-suite fields live at detail's top level in the final
         # layout; mirror that in the partial so recovery needs no remap
-        running_detail.update(json.loads(json.dumps(d)))
+        try:
+            running_detail.update(json.loads(json.dumps(d)))
+        except (TypeError, ValueError):
+            return  # non-serializable fragment: skip it, keep measuring
         _flush_partial()
 
     _stamp("inference suite (batch sweep)")
@@ -657,7 +663,10 @@ def _probe_backend(timeout_s: float, log) -> tuple:
     whole TPU budget burns with zero rows measured. A canary hang
     instead surfaces here as DEVICES_OK-without-PROBE_OK inside
     ``timeout_s``, and the artifact falls back to CPU with that
-    diagnostic in ``tpu_error``. Returns (ok, reason)."""
+    diagnostic in ``tpu_error``. Returns ``(ok, reason, platform)`` —
+    ``platform`` is the backend the probe actually saw (``"tpu"``,
+    ``"cpu"``, ...) or None when the probe failed before reporting
+    one."""
     import sys
 
     code = (
@@ -697,38 +706,46 @@ def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
     import tempfile
 
     out_json = tempfile.NamedTemporaryFile(suffix=".json", delete=False).name
-    cmd = [sys.executable, "-m", "roko_tpu.benchmark", "--in-process"]
-    cmd += ["--out", out_json]
-    if args.train:
-        cmd.append("--train")
-    if args.features:
-        cmd.append("--features")
-    if args.batch is not None:
-        cmd += ["--batch", str(args.batch)]
-    if getattr(args, "e2e_draft", None) is not None:
-        cmd += ["--e2e-draft", str(args.e2e_draft)]
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    rc, out = _spawn_logged(cmd, budget_s, cwd=repo_root)
-    if rc == 0:
+    try:
+        cmd = [sys.executable, "-m", "roko_tpu.benchmark", "--in-process"]
+        cmd += ["--out", out_json]
+        if args.train:
+            cmd.append("--train")
+        if args.features:
+            cmd.append("--features")
+        if args.batch is not None:
+            cmd += ["--batch", str(args.batch)]
+        if getattr(args, "e2e_draft", None) is not None:
+            cmd += ["--e2e-draft", str(args.e2e_draft)]
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        rc, out = _spawn_logged(cmd, budget_s, cwd=repo_root)
+        if rc == 0:
+            try:
+                with open(out_json) as f:
+                    result = json.load(f)
+                if not result.get("partial"):
+                    return result
+                # _recover_partial re-reads the file below
+                log("[bench] child rc=0 but left only a partial result")
+            except (OSError, ValueError) as e:
+                log(f"[bench] child rc=0 but result unreadable: {e}")
+                return None
+        how = "timed out (abandoned)" if rc is None else f"rc={rc}"
+        log(f"[bench] TPU child {how}; log tail:\n{out[-1500:]}")
+        # The child flushes every completed measurement to --out as it
+        # goes (see _measure._flush_partial). Salvage whatever the chip
+        # answered before going dark: a partial TPU artifact with real
+        # sweep rows beats a complete CPU fallback (r3/r4 lesson — the
+        # headline is a TPU number or it is nothing).
+        return _recover_partial(out_json, how, log, platform)
+    finally:
+        # delete=False temp: every exit path above — full result,
+        # unreadable result, failed salvage — must drop the file, not
+        # just the successful-salvage path (temp-file leak otherwise)
         try:
-            with open(out_json) as f:
-                result = json.load(f)
-            if not result.get("partial"):
-                os.unlink(out_json)
-                return result
-            # leave the file in place: _recover_partial re-reads it
-            log("[bench] child rc=0 but left only a partial result")
-        except (OSError, ValueError) as e:
-            log(f"[bench] child rc=0 but result unreadable: {e}")
-            return None
-    how = "timed out (abandoned)" if rc is None else f"rc={rc}"
-    log(f"[bench] TPU child {how}; log tail:\n{out[-1500:]}")
-    # The child flushes every completed measurement to --out as it goes
-    # (see _measure._flush_partial). Salvage whatever the chip answered
-    # before going dark: a partial TPU artifact with real sweep rows
-    # beats a complete CPU fallback (r3/r4 lesson — the headline is a
-    # TPU number or it is nothing).
-    return _recover_partial(out_json, how, log, platform)
+            os.unlink(out_json)
+        except OSError:
+            pass
 
 
 def _recover_partial(out_json: str, how: str, log, platform: str = "tpu"):

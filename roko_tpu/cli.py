@@ -112,9 +112,15 @@ def _build_config(args: argparse.Namespace):
         dropout_rng_impl="dropout_rng_impl",
     )
     mesh = over(base.mesh, dp="dp", tp="tp", sp="sp")
+    serve = over(
+        base.serve,
+        host="host", port="port", max_queue="max_queue",
+        max_delay_ms="max_delay_ms", data_root="data_root",
+        ladder="ladder",  # already a tuple via the _ladder_type callback
+    )
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
-        model=model, train=train, mesh=mesh,
+        model=model, train=train, mesh=mesh, serve=serve,
     )
 
 
@@ -282,6 +288,37 @@ def cmd_polish(args: argparse.Namespace) -> int:
 
         if jax.process_index() == 0:
             _print_assess(args.out, args.truth)
+    return 0
+
+
+def _ladder_type(text: str):
+    """argparse type for --ladder: a clean usage error on a malformed
+    list, not a raw int() traceback from deep inside config layering."""
+    try:
+        rungs = tuple(int(t) for t in text.split(","))
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected comma-separated integers, got {text!r}"
+        ) from None
+    if not rungs:
+        raise argparse.ArgumentTypeError("ladder must name a batch size")
+    return rungs
+
+
+def cmd_serve(args: argparse.Namespace) -> int:
+    """Long-lived polishing service (roko_tpu/serve, docs/SERVING.md):
+    load params once, pre-compile the padded-batch ladder, then serve
+    ``POST /polish`` with dynamic micro-batching until interrupted."""
+    from roko_tpu.serve import PolishSession, make_server, serve_forever
+
+    cfg = _build_config(args)
+    params = _load_model_params(args.model, cfg)
+    session = PolishSession(params, cfg)
+    print(f"serve: warming predict ladder {session.ladder} ...")
+    compiled = session.warmup()
+    print(f"serve: {compiled} executables compiled; accepting requests")
+    server = make_server(session, cfg.serve)
+    serve_forever(server)
     return 0
 
 
@@ -497,6 +534,34 @@ def build_parser() -> argparse.ArgumentParser:
     _mesh_args(p)
     _window_args(p)
     p.set_defaults(fn=cmd_polish)
+
+    p = sub.add_parser(
+        "serve",
+        help="persistent polishing service: warm model + micro-batched "
+        "HTTP /polish (+ /healthz, /metrics)",
+    )
+    p.add_argument("model", help="checkpoint dir, saved params, or torch .pth")
+    p.add_argument("--host", default=None, help="bind address (default 127.0.0.1)")
+    p.add_argument("--port", type=int, default=None, help="bind port (default 8000; 0 = ephemeral)")
+    p.add_argument(
+        "--ladder", type=_ladder_type, default=None,
+        help="comma-separated padded batch sizes to pre-compile "
+        "(default 32,128,512; each must divide by the dp mesh axis)",
+    )
+    p.add_argument("--max-queue", type=int, default=None,
+                   help="bounded request queue size (full -> 503 + Retry-After)")
+    p.add_argument("--max-delay-ms", type=float, default=None,
+                   help="micro-batch deadline from first queued request")
+    p.add_argument(
+        "--data-root", default=None,
+        help="confine the /polish ref+bam form to files under this "
+        "directory (recommended when binding beyond localhost)",
+    )
+    _config_arg(p)
+    _model_args(p)
+    _mesh_args(p)
+    _window_args(p)
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(
         "inspect", help="summarise a features HDF5 file or directory"

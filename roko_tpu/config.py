@@ -142,6 +142,33 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class ServeConfig:
+    """Persistent polishing service (roko_tpu/serve, docs/SERVING.md)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+    #: padded batch-size ladder the session pre-compiles; every dispatch
+    #: pads to a rung so no request shape ever triggers a recompile.
+    #: Rungs must each divide by the mesh dp axis.
+    ladder: Tuple[int, ...] = (32, 128, 512)
+    #: bounded request queue — submissions beyond this are rejected with
+    #: a retry-after instead of growing host memory (backpressure)
+    max_queue: int = 64
+    #: micro-batching deadline: a partially filled batch dispatches at
+    #: most this long after its first request arrived
+    max_delay_ms: float = 25.0
+    #: seconds a rejected client is told to wait before retrying
+    retry_after_s: float = 1.0
+    #: per-stage latency reservoir size backing the /metrics p50/p99 rows
+    latency_samples: int = 1024
+    #: confine the POST /polish ref+bam convenience form (which opens
+    #: server-local files named by the client) to paths under this
+    #: directory; None = any readable path — acceptable on the default
+    #: loopback bind, set this when binding beyond localhost
+    data_root: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class RokoConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
@@ -149,6 +176,7 @@ class RokoConfig:
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -164,6 +192,8 @@ class RokoConfig:
                                  for k, v in raw.get("model", {}).items()}),
             train=TrainConfig(**raw.get("train", {})),
             mesh=MeshConfig(**raw.get("mesh", {})),
+            serve=ServeConfig(**{k: tuple(v) if k == "ladder" else v
+                                 for k, v in raw.get("serve", {}).items()}),
         )
 
 

@@ -54,6 +54,20 @@ Params = Dict[str, Any]
 _SLOTS = C.MAX_INS + 1  # ins 0..3
 
 
+def pad_windows(x: np.ndarray, batch_size: int) -> np.ndarray:
+    """Zero-pad a window batch to exactly ``batch_size`` rows so every
+    dispatch reuses one compiled executable (fixed shapes). Shared by the
+    batch-job loop below and the serving session's shape ladder
+    (roko_tpu/serve/session.py)."""
+    n = x.shape[0]
+    if n == batch_size:
+        return x
+    if n > batch_size:
+        raise ValueError(f"batch of {n} windows exceeds pad target {batch_size}")
+    pad = batch_size - n
+    return np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+
+
 def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
     """jit'd forward + argmax: uint8[B,200,90] -> int32[B,90] class ids.
     Batch and output both sharded over dp; the host fetch concatenates."""
@@ -298,6 +312,12 @@ class VoteBoard:
         ].tobytes().decode()
         return draft[:first_pos] + body + draft[last_pos + 1 :]
 
+    def stitch_all(self) -> Dict[str, str]:
+        """Consensus for every contig this board knows. The per-request
+        unit of the serving path (one board per request) and the final
+        step of the batch path below share this."""
+        return {name: self.stitch(name) for name in self.contigs}
+
 
 def run_inference(
     data_path: str,
@@ -360,9 +380,7 @@ def run_inference(
     def place(item):
         names, positions, x, release = item
         n = len(names)
-        if n < batch_size:  # fixed shapes keep one compiled executable
-            pad = batch_size - n
-            x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+        x = pad_windows(x, batch_size)  # fixed shapes keep one executable
         # device_put dispatches asynchronously, so timing it here would
         # read ~0 and misattribute the transfer to the predict span —
         # transfer cost shows up inside "predict+d2h"
@@ -413,7 +431,7 @@ def run_inference(
     )
 
     with timer("stitch"):
-        polished = {name: board.stitch(name) for name in contigs}
+        polished = board.stitch_all()
     timer.report(log)
     return polished
 

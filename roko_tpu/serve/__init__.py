@@ -1,0 +1,39 @@
+"""Persistent polishing service (docs/SERVING.md).
+
+The batch CLI path (`cli.py` -> `infer.run_inference`) pays model load +
+XLA compile on every invocation. This package keeps one warm
+:class:`~roko_tpu.serve.session.PolishSession` resident — params loaded
+once, the predict step pre-compiled for a small ladder of padded batch
+sizes — and puts a dynamic micro-batcher plus a stdlib HTTP front end
+over it, the structure LLM-serving stacks use to turn one jit'd step
+into a service (PAPERS.md: t5x arxiv 2203.17189; dynamic batching of
+heterogeneous requests per Ragged Paged Attention, arxiv 2604.15464).
+
+Modules:
+
+- ``session``  — warm params + shape-ladder predict dispatch, recompile-free
+- ``batcher``  — bounded-queue dynamic micro-batching with a latency
+  deadline and explicit backpressure
+- ``metrics``  — Prometheus-style text counters over
+  :class:`roko_tpu.utils.profiling.StageTimer`
+- ``server``   — ``ThreadingHTTPServer`` front end
+  (``POST /polish``, ``GET /healthz``, ``GET /metrics``)
+- ``client``   — stdlib urllib client used by tests and ``tools/``
+"""
+
+from roko_tpu.serve.batcher import Backpressure, MicroBatcher
+from roko_tpu.serve.client import PolishClient, ServerBusy
+from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.server import make_server, serve_forever
+from roko_tpu.serve.session import PolishSession
+
+__all__ = [
+    "Backpressure",
+    "MicroBatcher",
+    "PolishClient",
+    "PolishSession",
+    "ServeMetrics",
+    "ServerBusy",
+    "make_server",
+    "serve_forever",
+]

@@ -1,0 +1,258 @@
+"""Dynamic micro-batching over a warm :class:`PolishSession`.
+
+Requests (each a batch of ``uint8[n, rows, cols]`` windows) land in a
+bounded queue; one worker thread coalesces them into device batches
+under two limits:
+
+- **fill**: stop gathering once the coalesced batch reaches the
+  session's top ladder rung (no point padding past it);
+- **deadline**: a partially filled batch dispatches at most
+  ``max_delay_ms`` after its FIRST request arrived, so a lone request's
+  latency is bounded by the deadline, not by traffic.
+
+Backpressure is explicit: when the queue is full, ``submit`` raises
+:class:`Backpressure` (the HTTP layer maps it to 503 + ``Retry-After``)
+instead of queueing unboundedly — throughput degrades gracefully under
+overload rather than OOMing the host (ISSUE tentpole; the same shape
+LLM serving uses for admission control).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.session import PolishSession
+
+
+class Backpressure(Exception):
+    """Request rejected because the queue is full; retry after
+    ``retry_after_s`` seconds."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"request queue full; retry after {retry_after_s:.1f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class _Request:
+    __slots__ = ("x", "done", "preds", "error", "t_submit")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.done = threading.Event()
+        self.preds: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+
+
+class PredictFuture:
+    """Handle for one submitted request."""
+
+    def __init__(self, req: _Request, metrics: Optional[ServeMetrics]):
+        self._req = req
+        self._metrics = metrics
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._req.done.wait(timeout):
+            raise TimeoutError("predict result not ready")
+        if self._req.error is not None:
+            raise self._req.error
+        if self._metrics is not None:
+            self._metrics.timer.record(
+                "request", time.perf_counter() - self._req.t_submit
+            )
+        return self._req.preds
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        session: PolishSession,
+        *,
+        max_queue: Optional[int] = None,
+        max_delay_ms: Optional[float] = None,
+        retry_after_s: Optional[float] = None,
+        metrics: Optional[ServeMetrics] = None,
+        start: bool = True,
+    ):
+        serve_cfg = session.cfg.serve
+        self.session = session
+        self.max_delay_s = (
+            serve_cfg.max_delay_ms if max_delay_ms is None else max_delay_ms
+        ) / 1e3
+        self.retry_after_s = (
+            serve_cfg.retry_after_s if retry_after_s is None else retry_after_s
+        )
+        self.metrics = metrics
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue(
+            maxsize=serve_cfg.max_queue if max_queue is None else max_queue
+        )
+        self._running = False
+        self._stopped = False  # set once by stop(); submissions then fail fast
+        self._thread: Optional[threading.Thread] = None
+        if metrics is not None:
+            metrics.queue_depth = self._q.qsize
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="roko-microbatcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stopped = True
+        if self._running:
+            self._running = False
+            self._repost_sentinel()  # wake the worker (best-effort)
+            if self._thread is not None:
+                self._thread.join(timeout)
+                self._thread = None
+        # second drain AFTER the worker is gone: a submit() racing
+        # stop() can land a request behind the worker's own final
+        # drain, and nothing would ever complete it
+        self._fail_queued()
+
+    def _fail_queued(self) -> None:
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if req is not None and not req.done.is_set():
+                req.error = RuntimeError("batcher stopped")
+                req.done.set()
+
+    # -- client side --------------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> PredictFuture:
+        """Enqueue one window batch; raises :class:`Backpressure` when
+        the queue is full and ``RuntimeError`` once the batcher has been
+        stopped (a dead worker must fail requests fast, not strand
+        their futures)."""
+        if self._stopped:
+            raise RuntimeError("batcher stopped")
+        req = _Request(np.ascontiguousarray(x, dtype=np.uint8))
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            if self.metrics is not None:
+                self.metrics.inc("rejected")
+            raise Backpressure(self.retry_after_s) from None
+        if self._stopped:
+            # raced stop(): the worker may already be gone, so nothing
+            # would drain this request — fail it here (done.set is
+            # idempotent; if the worker did take it, its result stands)
+            self._fail_queued()
+        if self.metrics is not None:
+            self.metrics.inc("requests")
+            self.metrics.inc("windows", len(req.x))
+        return PredictFuture(req, self.metrics)
+
+    def predict(
+        self, x: np.ndarray, timeout: Optional[float] = None
+    ) -> np.ndarray:
+        """submit + result in one call (the HTTP handler's path)."""
+        return self.submit(x).result(timeout)
+
+    # -- worker side --------------------------------------------------------
+
+    def _gather(self, first: _Request) -> List[_Request]:
+        """Coalesce queued requests behind ``first`` until the top rung
+        fills or the deadline expires. Factored from the loop so tests
+        can drive it synchronously.
+
+        Two phases, so batching survives backlog: already-queued
+        requests coalesce unconditionally (their age is irrelevant —
+        under load, when the previous dispatch outlived the deadline,
+        the backlog must still form full batches or device throughput
+        collapses to one padded request per dispatch); the deadline
+        only bounds how long a PARTIAL batch waits for NEW arrivals,
+        measured from ``first``'s submit so a lone request's latency
+        stays <= max_delay_ms."""
+        batch = [first]
+        total = len(first.x)
+        top = self.session.ladder[-1]
+        deadline = first.t_submit + self.max_delay_s
+        while total < top:
+            try:  # phase 1: drain the existing backlog, no waiting
+                req = self._q.get_nowait()
+            except queue.Empty:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:  # phase 2: wait out the deadline for new arrivals
+                    req = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+            if req is None:  # shutdown sentinel
+                self._repost_sentinel()  # for the outer loop
+                break
+            batch.append(req)
+            total += len(req.x)
+        return batch
+
+    def _repost_sentinel(self) -> None:
+        # never a blocking put: on a full queue it would deadlock the
+        # only consumer; the outer loop's _running check (0.1 s poll)
+        # ends the worker even when the sentinel is lost
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
+
+    def _dispatch(self, batch: List[_Request]) -> None:
+        """Predict one coalesced batch and scatter results back."""
+        sizes = [len(r.x) for r in batch]
+        total = sum(sizes)
+        try:
+            x = (
+                batch[0].x
+                if len(batch) == 1
+                else np.concatenate([r.x for r in batch])
+            )
+            preds = self.session.predict(x)
+        except BaseException as e:  # propagate to every waiter
+            for r in batch:
+                r.error = e
+                r.done.set()
+            # errors_total is counted per failed REQUEST where the
+            # exception resurfaces (PredictFuture.result -> the HTTP
+            # 500 handler) — counting the shared batch failure here too
+            # would inflate the series by 1 per coalesced batch
+            return
+        off = 0
+        for r, n in zip(batch, sizes):
+            r.preds = preds[off : off + n]
+            off += n
+            r.done.set()
+        if self.metrics is not None:
+            self.metrics.inc("batches")
+            self.metrics.observe_fill(
+                total, max(1, self.session.padded_size(total))
+            )
+
+    def _loop(self) -> None:
+        while self._running:
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is None:
+                break
+            self._dispatch(self._gather(first))
+        # drain: fail any stragglers loudly rather than hanging clients
+        self._fail_queued()

@@ -1,0 +1,119 @@
+"""Stdlib client for the polishing service (tests + ``tools/``).
+
+Encodes window arrays in the wire format ``server.py`` expects
+(base64 raw little-endian), maps the server's backpressure reply to
+:class:`ServerBusy` with the parsed ``retry_after_s``, and optionally
+retries through it.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class ServerBusy(RuntimeError):
+    """503 from the service: queue full, retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(f"server busy; retry after {retry_after_s:.1f}s")
+        self.retry_after_s = retry_after_s
+
+
+def _b64(arr: np.ndarray, dtype) -> str:
+    return base64.b64encode(
+        np.ascontiguousarray(arr, dtype=np.dtype(dtype).newbyteorder("<"))
+        .tobytes()
+    ).decode("ascii")
+
+
+class PolishClient:
+    def __init__(self, base_url: str, timeout: float = 120.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # -- transport ----------------------------------------------------------
+
+    def _request(
+        self, path: str, payload: Optional[Dict[str, Any]] = None
+    ) -> Any:
+        url = self.base_url + path
+        data = None if payload is None else json.dumps(payload).encode()
+        req = urllib.request.Request(
+            url,
+            data=data,
+            headers={"Content-Type": "application/json"} if data else {},
+            method="POST" if data else "GET",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read()
+        except urllib.error.HTTPError as e:
+            body = e.read()
+            if e.code == 503:
+                try:
+                    retry = float(json.loads(body).get("retry_after_s", 1.0))
+                except (ValueError, AttributeError):
+                    retry = 1.0
+                raise ServerBusy(retry) from None
+            try:
+                detail = json.loads(body).get("error", "")
+            except ValueError:
+                detail = body[:200].decode(errors="replace")
+            raise RuntimeError(f"HTTP {e.code} from {path}: {detail}") from None
+        return body
+
+    # -- routes -------------------------------------------------------------
+
+    def healthz(self) -> Dict[str, Any]:
+        return json.loads(self._request("/healthz"))
+
+    def metrics(self) -> str:
+        return self._request("/metrics").decode()
+
+    def polish(
+        self,
+        draft: str,
+        positions: np.ndarray,
+        examples: np.ndarray,
+        contig: str = "seq",
+        retries: int = 0,
+    ) -> Dict[str, Any]:
+        """Polish one contig from pre-extracted windows. ``retries`` > 0
+        sleeps through :class:`ServerBusy` replies (honouring the
+        server's retry-after) before giving up."""
+        examples = np.asarray(examples)
+        payload = {
+            "contig": contig,
+            "draft": draft,
+            "n": int(examples.shape[0]),
+            "positions": _b64(positions, np.int64),
+            "examples": _b64(examples, np.uint8),
+        }
+        for attempt in range(retries + 1):
+            try:
+                return json.loads(self._request("/polish", payload))
+            except ServerBusy as busy:
+                if attempt == retries:
+                    raise
+                time.sleep(busy.retry_after_s)
+        raise AssertionError("unreachable")
+
+    def polish_bam(
+        self, ref: str, bam: str, workers: int = 1, seed: int = 0
+    ) -> Dict[str, Any]:
+        """Extractor convenience path: ``ref``/``bam`` are paths on the
+        SERVER's filesystem; ``seed`` is the row-sampling seed (matches
+        the ``features`` CLI's ``--seed``)."""
+        return json.loads(
+            self._request(
+                "/polish",
+                {"ref": ref, "bam": bam, "workers": workers, "seed": seed},
+            )
+        )

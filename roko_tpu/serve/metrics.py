@@ -1,0 +1,81 @@
+"""Prometheus-style text metrics for the polishing service.
+
+Counters plus a bounded latency reservoir rendered in the Prometheus
+text exposition format (counter/gauge/summary lines), built on
+:class:`roko_tpu.utils.profiling.StageTimer` — the same span machinery
+the batch pipeline reports with, so serving latency attribution and
+batch-job attribution share one implementation.
+
+Exposed series (all prefixed ``roko_serve_``):
+
+- ``requests_total``, ``windows_total``, ``batches_total``,
+  ``rejected_total``, ``errors_total`` — monotonic counters;
+- ``queue_depth`` — gauge, sampled at scrape time;
+- ``batch_fill_ratio`` — gauge, windows dispatched / padded rows over
+  the service lifetime (how much of each padded device batch was real
+  work);
+- ``request_latency_seconds{quantile="0.5"|"0.99"}`` + ``_count`` /
+  ``_sum`` — summary over the retained sample window.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from roko_tpu.utils.profiling import StageTimer
+
+_PREFIX = "roko_serve_"
+_COUNTERS = ("requests", "windows", "batches", "rejected", "errors")
+
+
+class ServeMetrics:
+    def __init__(self, latency_samples: int = 1024):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
+        self.timer = StageTimer(max_samples=latency_samples)
+        #: windows actually dispatched / padded rows dispatched
+        self._fill_windows = 0
+        self._fill_padded = 0
+        #: scrape-time gauge; the batcher points this at its queue
+        self.queue_depth: Callable[[], int] = lambda: 0
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += by
+
+    def observe_fill(self, windows: int, padded: int) -> None:
+        with self._lock:
+            self._fill_windows += windows
+            self._fill_padded += padded
+
+    def fill_ratio(self) -> Optional[float]:
+        with self._lock:
+            if not self._fill_padded:
+                return None
+            return self._fill_windows / self._fill_padded
+
+    def render(self) -> str:
+        """The ``GET /metrics`` body."""
+        lines = []
+        for name in _COUNTERS:
+            full = f"{_PREFIX}{name}_total"
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {self.counters[name]}")
+        lines.append(f"# TYPE {_PREFIX}queue_depth gauge")
+        lines.append(f"{_PREFIX}queue_depth {int(self.queue_depth())}")
+        fill = self.fill_ratio()
+        lines.append(f"# TYPE {_PREFIX}batch_fill_ratio gauge")
+        lines.append(
+            f"{_PREFIX}batch_fill_ratio "
+            + ("NaN" if fill is None else f"{fill:.4f}")
+        )
+        lat = f"{_PREFIX}request_latency_seconds"
+        lines.append(f"# TYPE {lat} summary")
+        for q in (50, 99):
+            v = self.timer.percentile("request", q)
+            if v is not None:
+                lines.append(f'{lat}{{quantile="0.{q}"}} {v:.6f}')
+        lines.append(f"{lat}_count {self.timer.counts.get('request', 0)}")
+        lines.append(f"{lat}_sum {self.timer.totals.get('request', 0.0):.6f}")
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,380 @@
+"""Stdlib HTTP front end for the polishing service (no new deps).
+
+``ThreadingHTTPServer`` + ``BaseHTTPRequestHandler`` only — the service
+has to run inside the baked container image, so the transport is
+deliberately boring; the interesting parts (warm session, micro-batch,
+backpressure) live behind it.
+
+Routes (payload schema: docs/SERVING.md):
+
+- ``POST /polish`` — JSON body, two forms:
+
+  1. **windows** (the wire format): ``contig``, ``draft``, ``n`` plus
+     ``positions`` / ``examples`` as base64 raw little-endian arrays
+     (``int64[n, cols, 2]`` / ``uint8[n, rows, cols]``) or small nested
+     lists. Returns the stitched contig.
+  2. **extractor convenience**: ``ref`` + ``bam`` (server-local paths)
+     — runs the ``features.pipeline`` extractor on the BAM and polishes
+     every contig. Returns ``{"contigs": {name: polished}}``.
+
+- ``GET /healthz`` — liveness + the compiled ladder.
+- ``GET /metrics`` — Prometheus text (``serve/metrics.py``).
+
+Backpressure surfaces as **503** with a ``Retry-After`` header; malformed
+payloads as **400**; anything unexpected as **500** with the exception
+type (message stays server-side in the log).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import sys
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from roko_tpu import constants as C
+from roko_tpu.config import ServeConfig
+from roko_tpu.infer import VoteBoard
+from roko_tpu.serve.batcher import Backpressure, MicroBatcher
+from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.session import PolishSession
+
+#: request bodies above this are refused before parsing (anti-OOM). One
+#: window costs ~26 kB of base64 JSON (18 kB examples + 1.9 kB positions
+#: before the 4/3 encoding overhead), so 256 MiB admits ~10k windows
+#: (~300 kb of draft at stride 30) per request — whole-contig jobs past
+#: that should use the ref+bam extractor form (server-side paths, no
+#: window upload) or the batch CLI (docs/SERVING.md).
+MAX_BODY_BYTES = 256 * 2**20
+
+#: hard ceiling on one handler's wait for its predict result — a hung
+#: or dead batcher worker must surface as an error response, not pin
+#: handler threads (and their connections) forever
+REQUEST_TIMEOUT_S = 600.0
+
+
+class _BadRequest(ValueError):
+    pass
+
+
+def _decode_array(
+    payload: Dict[str, Any], key: str, dtype, shape: Tuple[int, ...]
+) -> np.ndarray:
+    """Base64 raw little-endian bytes, or nested lists for small
+    hand-written payloads; always validated against ``shape``."""
+    raw = payload.get(key)
+    if raw is None:
+        raise _BadRequest(f"missing field {key!r}")
+    if isinstance(raw, str):
+        try:
+            buf = base64.b64decode(raw.encode("ascii"), validate=True)
+        except Exception:
+            raise _BadRequest(f"field {key!r} is not valid base64") from None
+        try:
+            arr = np.frombuffer(buf, dtype=np.dtype(dtype).newbyteorder("<"))
+        except ValueError:  # byte count not a multiple of the item size
+            raise _BadRequest(
+                f"field {key!r} decodes to {len(buf)} bytes, not a "
+                f"whole number of {np.dtype(dtype).name} elements"
+            ) from None
+        arr = arr.astype(dtype, copy=False)
+    else:
+        try:
+            arr = np.asarray(raw, dtype=dtype)
+        except (TypeError, ValueError):
+            raise _BadRequest(
+                f"field {key!r} is not a well-formed {np.dtype(dtype).name} "
+                "array"
+            ) from None
+    try:
+        arr = arr.reshape(shape)
+    except ValueError:
+        raise _BadRequest(
+            f"field {key!r} has {arr.size} elements, want shape {shape}"
+        ) from None
+    return arr
+
+
+def _polish_windows(
+    batcher: MicroBatcher, payload: Dict[str, Any]
+) -> Dict[str, Any]:
+    cfg = batcher.session.cfg.model
+    draft = payload.get("draft")
+    if not isinstance(draft, str) or not draft:
+        raise _BadRequest("missing field 'draft' (contig sequence)")
+    contig = payload.get("contig", "seq")
+    try:
+        n = int(payload["n"])
+    except (KeyError, TypeError, ValueError):
+        raise _BadRequest("missing/invalid field 'n' (window count)") from None
+    if n < 0:
+        raise _BadRequest("'n' must be >= 0")
+    positions = _decode_array(
+        payload, "positions", np.int64, (n, cfg.window_cols, 2)
+    )
+    examples = _decode_array(
+        payload, "examples", np.uint8, (n, cfg.window_rows, cfg.window_cols)
+    )
+    if n:
+        # value-validate client positions before they reach the vote
+        # board: an out-of-range pos would crash the scatter (500) and a
+        # negative one would WRAP via numpy indexing — votes landing on
+        # the wrong draft bases and a silently corrupt 200 reply
+        pos, ins = positions[:, :, 0], positions[:, :, 1]
+        if (
+            int(pos.min()) < 0 or int(pos.max()) >= len(draft)
+            or int(ins.min()) < 0 or int(ins.max()) > C.MAX_INS
+        ):
+            raise _BadRequest(
+                f"positions out of range: pos must lie in [0, {len(draft)})"
+                f" (draft length) and ins in [0, {C.MAX_INS}]"
+            )
+    preds = batcher.predict(examples, timeout=REQUEST_TIMEOUT_S)
+    board = VoteBoard({contig: draft})
+    board.add([contig] * n, positions, preds)
+    return {"contig": contig, "polished": board.stitch(contig), "windows": n}
+
+
+def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
+    """Validate a client-named server-local path. ONE error message for
+    every failure mode (bad type, outside the root, missing): the reply
+    must not be a file-existence oracle for unauthenticated peers."""
+    import os
+
+    err = _BadRequest(
+        f"field {label!r} must name a readable data file"
+        + (f" under the configured data root" if data_root else "")
+    )
+    if not isinstance(path, str) or not path:
+        raise err
+    real = os.path.realpath(path)
+    if data_root is not None:
+        root = os.path.realpath(data_root)
+        if real != root and not real.startswith(root + os.sep):
+            raise err
+    if not os.path.isfile(real):
+        raise err
+    return real
+
+
+def _polish_bam(
+    batcher: MicroBatcher, payload: Dict[str, Any],
+    data_root: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Extractor convenience path: feature-extract a server-local
+    ref+BAM through ``features.pipeline`` and polish every contig
+    through the same batcher as the wire path."""
+    import os
+    import tempfile
+
+    from roko_tpu.data.hdf5 import iter_inference_windows, load_contigs
+    from roko_tpu.features.pipeline import run_features
+
+    ref = _check_data_path("ref", payload.get("ref"), data_root)
+    bam = _check_data_path("bam", payload.get("bam"), data_root)
+    try:
+        workers = int(payload.get("workers", 1))
+        seed = int(payload.get("seed", 0))
+    except (TypeError, ValueError):
+        raise _BadRequest(
+            "fields 'workers'/'seed' must be integers"
+        ) from None
+    # a client names how much extraction parallelism it wants, the
+    # server decides how much it grants: clamp to the host's cores so
+    # one request can't command an arbitrary process fan-out
+    workers = max(1, min(workers, os.cpu_count() or 1))
+    session = batcher.session
+    with tempfile.TemporaryDirectory() as td:
+        h5 = os.path.join(td, "serve_features.hdf5")
+        n = run_features(
+            ref, bam, h5, workers=workers, seed=seed, config=session.cfg,
+            log=lambda *_a, **_k: None,
+        )
+        board = VoteBoard(load_contigs(h5))
+        # feed extractor batches at the top rung so the feature read and
+        # device dispatch pipeline as in the batch path
+        for names, positions, x in iter_inference_windows(
+            h5, session.ladder[-1]
+        ):
+            board.add(
+                names, positions,
+                batcher.predict(x, timeout=REQUEST_TIMEOUT_S),
+            )
+    return {"contigs": board.stitch_all(), "windows": n}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by make_server on the class copy
+    batcher: MicroBatcher
+    metrics: ServeMetrics
+    data_root: Optional[str] = None
+
+    protocol_version = "HTTP/1.1"
+    #: socket timeout for reads on one request: a peer that promises
+    #: Content-Length bytes and stalls mid-body must not pin a handler
+    #: thread forever (slowloris); on timeout the connection closes
+    timeout = 120.0
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass  # quiet by default; metrics carry the signal
+
+    def _reply(
+        self,
+        code: int,
+        body: bytes,
+        content_type: str = "application/json",
+        extra: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _reply_json(self, code: int, obj: Dict[str, Any], **kw: Any) -> None:
+        self._reply(code, json.dumps(obj).encode(), **kw)
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path == "/healthz":
+            session = self.batcher.session
+            self._reply_json(
+                200,
+                {
+                    "status": "ok",
+                    "ladder": list(session.ladder),
+                    "compiled": session.cache_size(),
+                },
+            )
+        elif self.path == "/metrics":
+            self._reply(
+                200,
+                self.metrics.render().encode(),
+                content_type="text/plain; version=0.0.4",
+            )
+        else:
+            self._reply_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path != "/polish":
+            self._reply_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+            except ValueError:
+                # body length unknown -> can't resync the keep-alive
+                # stream; close after replying
+                self.close_connection = True
+                self._reply_json(400, {"error": "bad Content-Length header"})
+                return
+            if length < 0:
+                # rfile.read(-1) would block until the peer closes —
+                # a handler thread pinned forever per such request
+                self.close_connection = True
+                self._reply_json(400, {"error": "bad Content-Length header"})
+                return
+            if length > MAX_BODY_BYTES:
+                # body left unread: a keep-alive peer would otherwise
+                # have its next request parsed out of these bytes
+                self.close_connection = True
+                self._reply_json(
+                    413, {"error": f"body exceeds {MAX_BODY_BYTES} bytes"}
+                )
+                return
+            payload = json.loads(self.rfile.read(length).decode())
+            if not isinstance(payload, dict):
+                raise _BadRequest("payload must be a JSON object")
+            if "bam" in payload:
+                result = _polish_bam(self.batcher, payload, self.data_root)
+            else:
+                result = _polish_windows(self.batcher, payload)
+            self._reply_json(200, result)
+        except Backpressure as e:
+            self._reply_json(
+                503,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                extra={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
+        except TimeoutError:
+            # either the batcher never answered within REQUEST_TIMEOUT_S
+            # (service unhealthy) or — socket.timeout IS TimeoutError on
+            # py>=3.10 — the peer stalled mid-body past the socket
+            # timeout. Shed the request; close the connection in both
+            # cases (a half-read body would desync the keep-alive
+            # stream, and an unhealthy service shouldn't pool it)
+            self.close_connection = True
+            self.metrics.inc("errors")
+            self._reply_json(
+                503,
+                {"error": "timed out reading the request or waiting for "
+                          "the predict result"},
+            )
+        except (_BadRequest, json.JSONDecodeError, UnicodeDecodeError) as e:
+            self._reply_json(400, {"error": str(e)})
+        except Exception as e:  # pragma: no cover - defensive
+            self.metrics.inc("errors")
+            # the 500 body carries only the type; the message + traceback
+            # stay server-side, but must actually be LOGGED or production
+            # 500s are undiagnosable (log_message is silenced)
+            traceback.print_exc(file=sys.stderr)
+            self._reply_json(500, {"error": type(e).__name__})
+
+
+def make_server(
+    session: PolishSession,
+    serve_cfg: Optional[ServeConfig] = None,
+    *,
+    batcher: Optional[MicroBatcher] = None,
+    metrics: Optional[ServeMetrics] = None,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral) and return the server; the caller runs
+    ``serve_forever``. The batcher/metrics ride on the server object
+    (``.batcher`` / ``.metrics``) so tests and the CLI can reach them."""
+    serve_cfg = serve_cfg or session.cfg.serve
+    metrics = metrics or ServeMetrics(latency_samples=serve_cfg.latency_samples)
+    # the default batcher takes its knobs from the EXPLICIT serve_cfg —
+    # MicroBatcher's own defaults read session.cfg.serve, which may be a
+    # different config object than the one passed here
+    batcher = batcher or MicroBatcher(
+        session,
+        metrics=metrics,
+        max_queue=serve_cfg.max_queue,
+        max_delay_ms=serve_cfg.max_delay_ms,
+        retry_after_s=serve_cfg.retry_after_s,
+    )
+    handler = type("RokoServeHandler", (_Handler,), {
+        "batcher": batcher, "metrics": metrics,
+        "data_root": serve_cfg.data_root,
+    })
+    server = ThreadingHTTPServer(
+        (serve_cfg.host if host is None else host,
+         serve_cfg.port if port is None else port),
+        handler,
+    )
+    server.daemon_threads = True
+    server.batcher = batcher  # type: ignore[attr-defined]
+    server.metrics = metrics  # type: ignore[attr-defined]
+    server.session = session  # type: ignore[attr-defined]
+    return server
+
+
+def serve_forever(server: ThreadingHTTPServer, log=print) -> None:
+    """Blocking loop with clean shutdown on Ctrl-C."""
+    host, port = server.server_address[:2]
+    log(f"roko serve: listening on http://{host}:{port} "
+        f"(POST /polish, GET /healthz, GET /metrics)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log("roko serve: shutting down")
+    finally:
+        server.batcher.stop()  # type: ignore[attr-defined]
+        server.server_close()

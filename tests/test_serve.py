@@ -1,0 +1,508 @@
+"""Serving subsystem tests (roko_tpu/serve, docs/SERVING.md): shape-ladder
+dispatch without recompiles, micro-batcher deadline/coalescing/backpressure,
+metrics rendering, and an end-to-end HTTP round trip whose stitched output
+must be byte-identical to ``infer.run_inference`` on the same windows/params
+(ISSUE 1 acceptance)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig, ServeConfig
+from roko_tpu.data.hdf5 import DataWriter
+from roko_tpu.infer import pad_windows, run_inference
+from roko_tpu.models.model import RokoModel
+from roko_tpu.serve import (
+    Backpressure,
+    MicroBatcher,
+    PolishClient,
+    PolishSession,
+    ServeMetrics,
+    ServerBusy,
+    make_server,
+)
+from roko_tpu.utils.profiling import StageTimer
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+CFG = RokoConfig(
+    model=TINY,
+    mesh=MeshConfig(dp=8),
+    serve=ServeConfig(ladder=(8, 16), max_delay_ms=20.0, max_queue=4),
+)
+
+
+@pytest.fixture(scope="module")
+def session():
+    """One warm session for the whole module: compiles the (8, 16)
+    ladder once; every test asserts it never compiles again."""
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    s = PolishSession(params, CFG)
+    s.warmup()
+    return s
+
+
+def _windows(rng, n):
+    """n feature windows + genome-ordered ins=0 positions."""
+    x = rng.integers(0, C.FEATURE_VOCAB, (n, 200, 90)).astype(np.uint8)
+    positions = np.zeros((n, 90, 2), np.int64)
+    for i in range(n):
+        positions[i, :, 0] = np.arange(i * C.WINDOW_STRIDE,
+                                       i * C.WINDOW_STRIDE + 90)
+    return positions, x
+
+
+# -- session / ladder --------------------------------------------------------
+
+
+def test_pad_windows_roundtrip(rng):
+    x = rng.integers(0, 10, (3, 4, 5)).astype(np.uint8)
+    padded = pad_windows(x, 8)
+    assert padded.shape == (8, 4, 5)
+    np.testing.assert_array_equal(padded[:3], x)
+    assert not padded[3:].any()
+    assert pad_windows(x, 3) is x
+    with pytest.raises(ValueError, match="exceeds pad target"):
+        pad_windows(x, 2)
+
+
+def test_session_rejects_bad_ladder():
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="not positive multiples"):
+        PolishSession(params, CFG, ladder=(12,))  # 12 % dp=8 != 0
+    with pytest.raises(ValueError, match="at least one"):
+        PolishSession(params, CFG, ladder=())
+
+
+def test_session_rung_and_padded_size(session):
+    assert session.ladder == (8, 16)
+    assert session.rung_for(1) == 8
+    assert session.rung_for(8) == 8
+    assert session.rung_for(9) == 16
+    assert session.rung_for(40) == 16  # callers chunk at the top rung
+    assert session.padded_size(3) == 8
+    assert session.padded_size(16) == 16
+    assert session.padded_size(20) == 16 + 8
+    assert session.padded_size(33) == 16 + 16 + 8
+
+
+def test_session_ladder_dispatch_without_recompile(session, rng):
+    """The acceptance bar: differing window counts after warmup hit only
+    pre-compiled shapes — jit cache entry count must not move."""
+    compiled = session.cache_size()
+    assert compiled >= len(session.ladder)
+    for n in (3, 9, 16, 20, 1):
+        preds = session.predict(
+            rng.integers(0, C.FEATURE_VOCAB, (n, 200, 90)).astype(np.uint8)
+        )
+        assert preds.shape == (n, 90)
+        assert preds.dtype == np.int32
+    assert session.cache_size() == compiled
+    assert session.dispatched_shapes <= set(session.ladder)
+
+
+def test_session_predict_matches_batch_padding(session, rng):
+    """Chunked ladder dispatch must equal one whole-batch dispatch —
+    padding and chunking change shapes, never predictions."""
+    x = rng.integers(0, C.FEATURE_VOCAB, (20, 200, 90)).astype(np.uint8)
+    whole = np.concatenate(
+        [session.predict(x[:16]), session.predict(x[16:])]
+    )
+    np.testing.assert_array_equal(session.predict(x), whole)
+
+
+def test_session_predict_rejects_wrong_geometry(session):
+    with pytest.raises(ValueError, match="windows shaped"):
+        session.predict(np.zeros((2, 10, 10), np.uint8))
+
+
+# -- micro-batcher -----------------------------------------------------------
+
+
+def test_batcher_deadline_flushes_partial_batch(session, rng):
+    """A lone request must not wait for a full batch: the deadline
+    dispatches it and the result arrives promptly."""
+    metrics = ServeMetrics()
+    batcher = MicroBatcher(session, metrics=metrics)
+    try:
+        _, x = _windows(rng, 3)
+        preds = batcher.predict(x, timeout=30.0)
+        assert preds.shape == (3, 90)
+        assert metrics.counters["batches"] == 1
+        assert metrics.counters["windows"] == 3
+        # 3 real windows padded to the 8-rung
+        assert metrics.fill_ratio() == pytest.approx(3 / 8)
+        assert metrics.timer.counts["request"] == 1
+    finally:
+        batcher.stop()
+
+
+def test_batcher_gather_coalesces_queued_requests(session, rng):
+    """Queued requests coalesce into one device batch (driven
+    synchronously through _gather/_dispatch — no timing races)."""
+    batcher = MicroBatcher(session, metrics=ServeMetrics(), start=False)
+    _, xa = _windows(rng, 3)
+    _, xb = _windows(rng, 4)
+    fa, fb = batcher.submit(xa), batcher.submit(xb)
+    first = batcher._q.get_nowait()
+    batch = batcher._gather(first)
+    assert [len(r.x) for r in batch] == [3, 4]
+    batcher._dispatch(batch)
+    np.testing.assert_array_equal(fa.result(0), session.predict(xa))
+    np.testing.assert_array_equal(fb.result(0), session.predict(xb))
+    assert batcher.metrics.counters["batches"] == 1
+    assert batcher.metrics.fill_ratio() == pytest.approx(7 / 8)
+
+
+def test_batcher_gather_coalesces_backlog_past_deadline(session, rng):
+    """Requests older than the deadline must STILL coalesce: under
+    load the backlog has aged past max_delay_ms by the time the worker
+    pops it, and dispatching them one-by-one would collapse batching
+    exactly when it matters. The deadline only bounds waiting for NEW
+    arrivals."""
+    batcher = MicroBatcher(
+        session, max_delay_ms=0.0, metrics=ServeMetrics(), start=False
+    )
+    _, x = _windows(rng, 2)
+    futs = [batcher.submit(x) for _ in range(3)]
+    batch = batcher._gather(batcher._q.get_nowait())
+    assert len(batch) == 3  # whole backlog in one batch despite deadline 0
+    batcher._dispatch(batch)
+    for f in futs:
+        assert f.result(0).shape == (2, 90)
+    assert batcher.metrics.counters["batches"] == 1
+
+
+def test_batcher_gather_stops_at_top_rung(session, rng):
+    """Coalescing stops once the top ladder rung is full — the rest of
+    the queue waits for the next batch instead of over-padding."""
+    batcher = MicroBatcher(session, start=False)
+    futs = [batcher.submit(_windows(rng, 6)[1]) for _ in range(3)]
+    batch = batcher._gather(batcher._q.get_nowait())
+    assert sum(len(r.x) for r in batch) >= 16  # 6+6+6 crosses the top rung
+    assert batcher._q.qsize() == 0
+    batcher._dispatch(batch)
+    for f in futs:
+        assert f.result(0).shape == (6, 90)
+
+
+def test_batcher_backpressure_rejects_when_full(session, rng):
+    """Queue full -> Backpressure with the configured retry-after, and
+    the rejection is counted; queued requests are untouched."""
+    metrics = ServeMetrics()
+    batcher = MicroBatcher(
+        session, max_queue=2, retry_after_s=2.5, metrics=metrics, start=False
+    )
+    _, x = _windows(rng, 1)
+    batcher.submit(x)
+    batcher.submit(x)
+    with pytest.raises(Backpressure) as exc:
+        batcher.submit(x)
+    assert exc.value.retry_after_s == 2.5
+    assert metrics.counters["rejected"] == 1
+    assert metrics.counters["requests"] == 2
+    assert metrics.queue_depth() == 2
+
+
+def test_batcher_submit_after_stop_fails_fast(session, rng):
+    """Requests must never strand on a dead worker: submit after stop
+    raises immediately, and requests queued across the stop race are
+    failed rather than left forever-pending."""
+    batcher = MicroBatcher(session, start=False)
+    _, x = _windows(rng, 1)
+    fut = batcher.submit(x)
+    batcher.stop()  # drains + fails the queued request
+    with pytest.raises(RuntimeError, match="batcher stopped"):
+        fut.result(0)
+    with pytest.raises(RuntimeError, match="batcher stopped"):
+        batcher.submit(x)
+
+
+def test_batcher_propagates_predict_errors(session):
+    """A bad request must fail ITS future, not wedge the worker."""
+    batcher = MicroBatcher(session, start=False)
+    fut = batcher.submit(np.zeros((2, 10, 10), np.uint8))
+    batcher._dispatch(batcher._gather(batcher._q.get_nowait()))
+    with pytest.raises(ValueError, match="windows shaped"):
+        fut.result(0)
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def test_stagetimer_percentiles():
+    t = StageTimer(max_samples=100)
+    for ms in range(1, 101):
+        t.record("request", ms / 1000)
+    assert t.percentile("request", 50) == pytest.approx(0.050, abs=0.002)
+    assert t.percentile("request", 99) == pytest.approx(0.099, abs=0.002)
+    assert t.percentile("nothing", 50) is None
+    assert t.counts["request"] == 100
+
+
+def test_stagetimer_sample_window_bounded():
+    t = StageTimer(max_samples=8)
+    for _ in range(100):
+        t.record("request", 1.0)
+    assert len(t.samples["request"]) == 8
+    assert t.counts["request"] == 100  # totals keep full history
+
+
+def test_metrics_render_prometheus_text():
+    m = ServeMetrics()
+    m.inc("requests", 3)
+    m.observe_fill(6, 8)
+    m.timer.record("request", 0.25)
+    text = m.render()
+    assert "# TYPE roko_serve_requests_total counter" in text
+    assert "roko_serve_requests_total 3" in text
+    assert "roko_serve_batch_fill_ratio 0.7500" in text
+    assert 'quantile="0.50"' in text and 'quantile="0.99"' in text
+    assert "roko_serve_request_latency_seconds_count 1" in text
+    # empty fill window renders NaN, not a crash
+    assert "batch_fill_ratio NaN" in ServeMetrics().render()
+
+
+def test_cli_serve_flags_layer_into_config():
+    """`roko-tpu serve` flags flow through _build_config into
+    ServeConfig (ladder parses from the comma list; unset flags defer
+    to the defaults)."""
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "ckpt/", "--port", "0", "--ladder", "8,16",
+         "--max-queue", "7", "--max-delay-ms", "5"]
+    )
+    cfg = _build_config(args)
+    assert cfg.serve.ladder == (8, 16)
+    assert cfg.serve.port == 0
+    assert cfg.serve.max_queue == 7
+    assert cfg.serve.max_delay_ms == 5.0
+    assert cfg.serve.host == "127.0.0.1"  # default preserved
+
+    defaults = _build_config(build_parser().parse_args(["serve", "ckpt/"]))
+    assert defaults.serve.ladder == (32, 128, 512)
+
+
+# -- HTTP end to end ---------------------------------------------------------
+
+
+@pytest.fixture
+def server(session):
+    srv = make_server(session, CFG.serve, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    yield srv
+    srv.shutdown()
+    srv.batcher.stop()
+    srv.server_close()
+    thread.join(5.0)
+
+
+def test_http_polish_matches_run_inference(server, session, rng, tmp_path):
+    """ISSUE 1 acceptance: POST /polish returns a stitched contig
+    byte-identical to run_inference on the same windows/params, with
+    zero recompiles across 3 requests of differing window counts."""
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    positions, x = _windows(rng, 7)
+
+    path = tmp_path / "infer.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", list(positions), list(x), None)
+    expected = run_inference(
+        str(path), session.params, CFG, batch_size=8, log=lambda s: None
+    )["ctg"]
+
+    client = PolishClient(f"http://127.0.0.1:{server.server_address[1]}")
+    compiled = client.healthz()["compiled"]
+    reply = client.polish(draft, positions, x, contig="ctg")
+    assert reply["polished"] == expected  # byte-identical
+    assert reply["windows"] == 7
+    # two more requests with differing window counts
+    for n in (5, 3):
+        r = client.polish(draft, positions[:n], x[:n], contig="ctg")
+        assert r["windows"] == n
+        assert set(r["polished"]) <= set("ACGT")
+    health = client.healthz()
+    assert health["status"] == "ok"
+    assert health["compiled"] == compiled  # zero predict-step recompiles
+    text = client.metrics()
+    assert "roko_serve_requests_total" in text
+    assert "roko_serve_queue_depth 0" in text
+    assert "roko_serve_request_latency_seconds_count" in text
+
+
+def test_http_bad_payloads_get_400(server, rng):
+    client = PolishClient(f"http://127.0.0.1:{server.server_address[1]}")
+    with pytest.raises(RuntimeError, match="HTTP 400.*draft"):
+        client._request("/polish", {"n": 1})
+    with pytest.raises(RuntimeError, match="HTTP 400.*base64"):
+        client._request(
+            "/polish",
+            {"draft": "ACGT", "n": 1, "positions": "!!", "examples": "!!"},
+        )
+    with pytest.raises(RuntimeError, match="HTTP 400.*elements"):
+        client._request(
+            "/polish",
+            {"draft": "ACGT", "n": 2, "positions": [[0, 0]], "examples": [1]},
+        )
+    # valid base64 of a truncated buffer (7 bytes into int64) -> 400
+    import base64
+
+    with pytest.raises(RuntimeError, match="HTTP 400.*whole number"):
+        client._request(
+            "/polish",
+            {"draft": "ACGT", "n": 1,
+             "positions": base64.b64encode(b"1234567").decode(),
+             "examples": base64.b64encode(b"x").decode()},
+        )
+    # ragged nested lists are a client mistake -> 400, not a 500
+    with pytest.raises(RuntimeError, match="HTTP 400.*well-formed"):
+        client._request(
+            "/polish",
+            {"draft": "ACGT", "n": 1, "positions": [[0, 0], [1]],
+             "examples": []},
+        )
+    with pytest.raises(RuntimeError, match="HTTP 404"):
+        client._request("/nope", {})
+
+
+def test_http_out_of_range_positions_get_400(server, rng):
+    """Position values past the draft (or negative, which would WRAP
+    through numpy indexing and corrupt votes silently) are a client
+    error, not a 500 or a wrong 200."""
+    client = PolishClient(f"http://127.0.0.1:{server.server_address[1]}")
+    positions, x = _windows(rng, 1)
+    draft = "ACGT" * 10  # 40 bases < the 90 columns the window spans
+    with pytest.raises(RuntimeError, match="HTTP 400.*out of range"):
+        client.polish(draft, positions, x, contig="ctg")
+    neg = positions.copy()
+    neg[0, 0, 0] = -1
+    long_draft = "".join(rng.choice(list("ACGT"), 200))
+    with pytest.raises(RuntimeError, match="HTTP 400.*out of range"):
+        client.polish(long_draft, neg, x, contig="ctg")
+
+
+def test_http_negative_content_length_gets_400(server):
+    """Content-Length: -1 must not reach rfile.read(-1) (which would
+    block the handler thread until the peer closes)."""
+    import http.client
+
+    conn = http.client.HTTPConnection(
+        "127.0.0.1", server.server_address[1], timeout=10
+    )
+    try:
+        conn.putrequest("POST", "/polish")
+        conn.putheader("Content-Length", "-1")
+        conn.endheaders()
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert b"Content-Length" in resp.read()
+    finally:
+        conn.close()
+
+
+def test_http_data_root_confines_extractor_paths(session, tmp_path):
+    """With data_root set, ref/bam outside it get the SAME 400 as a
+    missing file — no filesystem-existence oracle, no opening
+    arbitrary server paths for network clients."""
+    import dataclasses
+
+    outside = tmp_path / "outside.fasta"
+    outside.write_text(">c\nACGT\n")
+    root = tmp_path / "root"
+    root.mkdir()
+    serve_cfg = dataclasses.replace(CFG.serve, data_root=str(root))
+    srv = make_server(session, serve_cfg, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = PolishClient(f"http://127.0.0.1:{srv.server_address[1]}")
+        messages = set()
+        for ref in (str(outside), str(root / "missing.fasta"), "/etc/passwd"):
+            with pytest.raises(RuntimeError, match="HTTP 400") as exc:
+                client.polish_bam(ref, ref)
+            messages.add(str(exc.value))
+        assert len(messages) == 1  # indistinguishable failure modes
+    finally:
+        srv.shutdown()
+        srv.batcher.stop()
+        srv.server_close()
+        thread.join(5.0)
+
+
+def test_http_backpressure_maps_to_503(session):
+    """A full queue surfaces as ServerBusy (503 + Retry-After) through
+    the client; the batcher is deliberately not started so submissions
+    stay queued."""
+    metrics = ServeMetrics()
+    batcher = MicroBatcher(
+        session, max_queue=1, metrics=metrics, start=False
+    )
+    srv = make_server(session, CFG.serve, batcher=batcher, metrics=metrics,
+                      port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        client = PolishClient(f"http://127.0.0.1:{srv.server_address[1]}")
+        rng = np.random.default_rng(0)
+        positions, x = _windows(rng, 1)
+        draft = "".join(rng.choice(list("ACGT"), 200))
+
+        # occupy the single queue slot from a background thread (its
+        # request blocks until we drain it)
+        first_sent = threading.Event()
+        results = {}
+
+        def occupy():
+            first_sent.set()
+            results["first"] = client.polish(draft, positions, x)
+
+        t = threading.Thread(target=occupy, daemon=True)
+        t.start()
+        first_sent.wait(5.0)
+        deadline = 50  # poll until the first request is queued
+        while batcher._q.qsize() == 0 and deadline:
+            deadline -= 1
+            threading.Event().wait(0.05)
+        with pytest.raises(ServerBusy) as exc:
+            client.polish(draft, positions, x)
+        assert exc.value.retry_after_s == CFG.serve.retry_after_s
+        assert metrics.counters["rejected"] == 1
+        # drain: start the worker, the occupying request completes
+        batcher.start()
+        t.join(30.0)
+        assert results["first"]["windows"] == 1
+    finally:
+        srv.shutdown()
+        batcher.stop()
+        srv.server_close()
+        thread.join(5.0)
+
+
+@pytest.mark.slow
+def test_http_polish_bam_extractor_path(server, session, tmp_path):
+    """Convenience path: ref+BAM on the server's filesystem go through
+    features.pipeline and the result matches the offline
+    run_features -> run_inference pipeline exactly."""
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.sim import build_synthetic_project
+
+    paths = build_synthetic_project(
+        str(tmp_path / "proj"), genome_len=3000, coverage=8
+    )
+    h5 = str(tmp_path / "offline.hdf5")
+    run_features(paths["draft_fasta"], paths["reads_bam"], h5, log=lambda *a: None)
+    expected = run_inference(
+        h5, session.params, CFG, batch_size=8, log=lambda s: None
+    )
+
+    client = PolishClient(
+        f"http://127.0.0.1:{server.server_address[1]}", timeout=300.0
+    )
+    reply = client.polish_bam(paths["draft_fasta"], paths["reads_bam"])
+    assert reply["contigs"] == expected

@@ -34,7 +34,9 @@ def main() -> int:
     if ok:
         print(f"CHIP_OK platform={platform}")
         return 0
-    print(f"CHIP_DOWN {why[:300]}")
+    # collapse whitespace/newlines: the probe reason embeds child log
+    # tails, and the docstring promises single-line output
+    print(f"CHIP_DOWN {' '.join(why.split())[:300]}")
     return 1
 
 

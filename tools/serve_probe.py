@@ -1,0 +1,86 @@
+"""Serving-stack smoke probe: start a server on an ephemeral port, send
+one polish request, print a single OK/FAIL line. Exit 0 = the whole
+stack (session warmup -> micro-batcher -> HTTP -> stitch) answered.
+
+    JAX_PLATFORMS=cpu python tools/serve_probe.py [--model CKPT] [--timeout 120]
+
+Without ``--model`` a tiny random-init model is used — the probe checks
+the serving machinery, not polish accuracy, so it runs anywhere the
+repo's tests run (CPU included) with no checkpoint or data.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None,
+                    help="checkpoint dir/params (default: tiny random init)")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    args = ap.parse_args()
+    t0 = time.perf_counter()
+
+    try:
+        import jax
+        import numpy as np
+
+        from roko_tpu.config import ModelConfig, RokoConfig, ServeConfig
+        from roko_tpu.models.model import RokoModel
+        from roko_tpu.serve import PolishClient, PolishSession, make_server
+
+        if args.model:
+            from roko_tpu.cli import _load_model_params
+
+            cfg = RokoConfig(serve=ServeConfig(ladder=(8,)))
+            params = _load_model_params(args.model, cfg)
+        else:
+            tiny = ModelConfig(
+                embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+            )
+            cfg = RokoConfig(model=tiny, serve=ServeConfig(ladder=(8,)))
+            params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+
+        session = PolishSession(params, cfg)
+        session.warmup()
+        server = make_server(session, cfg.serve, port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        client = PolishClient(
+            f"http://127.0.0.1:{server.server_address[1]}",
+            timeout=args.timeout,
+        )
+
+        assert client.healthz()["status"] == "ok"
+        rng = np.random.default_rng(0)
+        n, rows, cols = 3, cfg.model.window_rows, cfg.model.window_cols
+        draft = "".join(rng.choice(list("ACGT"), 200))
+        positions = np.zeros((n, cols, 2), np.int64)
+        for i in range(n):
+            positions[i, :, 0] = np.arange(i * 30, i * 30 + cols)
+        examples = rng.integers(0, 90, (n, rows, cols)).astype(np.uint8)
+        reply = client.polish(draft, positions, examples, contig="ctg")
+        assert reply["windows"] == n and reply["polished"], reply
+        assert "roko_serve_requests_total 1" in client.metrics()
+        server.shutdown()
+        server.batcher.stop()
+    except Exception as e:  # single-line FAIL, never a traceback
+        msg = " ".join(f"{type(e).__name__}: {e}".split())
+        print(f"SERVE_FAIL {msg[:300]}")
+        return 1
+    print(
+        f"SERVE_OK polished={len(reply['polished'])}b "
+        f"compiled={session.cache_size()} "
+        f"t={time.perf_counter() - t0:.1f}s"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
