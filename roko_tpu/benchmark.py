@@ -546,6 +546,26 @@ def _measure(args) -> Dict[str, Any]:
         except Exception as e:  # report, never swallow
             detail["end_to_end"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("end_to_end", detail["end_to_end"])
+    pipeline_draft = getattr(args, "pipeline_draft", None)
+    if pipeline_draft is None:
+        # default follows the e2e suite's resolved scale decision: a
+        # run that disabled e2e (--e2e-draft 0 — the cheap contract
+        # mode tests use) skips this suite too, while the driver's
+        # plain `python bench.py` gets both. Sized below e2e because
+        # this suite runs the same stages TWICE (staged + streaming).
+        if not e2e_draft:
+            pipeline_draft = 0
+        else:
+            pipeline_draft = (
+                500_000 if jax.default_backend() == "tpu" else 60_000
+            )
+    if pipeline_draft:
+        _stamp(f"pipeline suite (staged vs streaming, draft {pipeline_draft})")
+        try:
+            detail["pipeline"] = run_pipeline_suite(pipeline_draft)
+        except Exception as e:  # report, never swallow
+            detail["pipeline"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("pipeline", detail["pipeline"])
     _stamp("torch reference")
     ref_windows_per_sec = bench_torch_reference()
     # provenance: which stack produced this artifact (BENCH_r{N}.json is
@@ -717,6 +737,8 @@ def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
             cmd += ["--batch", str(args.batch)]
         if getattr(args, "e2e_draft", None) is not None:
             cmd += ["--e2e-draft", str(args.e2e_draft)]
+        if getattr(args, "pipeline_draft", None) is not None:
+            cmd += ["--pipeline-draft", str(args.pipeline_draft)]
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         rc, out = _spawn_logged(cmd, budget_s, cwd=repo_root)
         if rc == 0:
@@ -887,6 +909,98 @@ def run_e2e_suite(draft_len: int = 2_000_000, coverage: int = 20) -> Dict[str, A
     return out
 
 
+def run_pipeline_suite(
+    draft_len: int = 60_000, coverage: int = 40, workers: Optional[int] = None
+) -> Dict[str, Any]:
+    """Staged vs STREAMING polish on the same sim inputs (ISSUE 2
+    tentpole evidence): the staged path runs ``run_features`` (HDF5)
+    then ``run_inference`` serially; the streaming engine
+    (roko_tpu/pipeline) overlaps extraction, host batching, and device
+    predict. Reports both wall times, the streaming StageTimer span
+    totals (sum > wall == stages actually overlapped), and
+    ``overlap_efficiency`` = staged serial sum / streaming wall — > 1
+    means the pipeline beat the sum of its stages. Also asserts the two
+    outputs match (``outputs_identical``); a mismatch is reported, not
+    raised, so a bench artifact always lands."""
+    import os
+    import random
+    import tempfile
+
+    import jax
+
+    from roko_tpu.config import ModelConfig, RokoConfig
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.infer import run_inference
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.pipeline import run_streaming_polish
+    from roko_tpu.sim import random_seq, simulate_reads
+    from roko_tpu.utils.profiling import StageTimer
+
+    if workers is None:
+        workers = max(1, os.cpu_count() or 1)
+    out: Dict[str, Any] = {
+        "draft_len": draft_len, "coverage": coverage, "workers": workers,
+    }
+    rng = random.Random(0)
+    with tempfile.TemporaryDirectory() as td:
+        fasta = os.path.join(td, "draft.fasta")
+        bam = os.path.join(td, "reads.bam")
+        h5 = os.path.join(td, "features.hdf5")
+        draft = random_seq(rng, draft_len)
+        read_len = min(3000, max(100, draft_len // 4))
+        records = simulate_reads(
+            rng, draft, 0, coverage=coverage, read_len=read_len
+        )
+        write_fasta(fasta, [("ctg", draft)])
+        write_sorted_bam(bam, [("ctg", draft_len)], records)
+
+        # the backend's fast dtype: bf16 rides the MXU on TPU but is
+        # EMULATED on CPU (~3x slower than f32) — the suite measures
+        # stage overlap, not dtype emulation
+        dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
+        cfg = RokoConfig(model=ModelConfig(compute_dtype=dtype))
+        params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+        quiet = lambda *a, **k: None  # noqa: E731
+
+        # both timed windows include one fresh predict-step compile
+        # (each run builds its own jit closure), so the one-off XLA
+        # cost appears on BOTH sides of the ratio instead of biasing it
+        staged: Dict[str, Any] = {}
+        t0 = time.perf_counter()
+        n = run_features(fasta, bam, h5, seed=0, workers=workers, log=quiet)
+        staged["features_s"] = round(time.perf_counter() - t0, 3)
+        t0 = time.perf_counter()
+        staged_polished = run_inference(
+            h5, params, cfg, batch_size=BATCH, log=quiet
+        )
+        staged["inference_s"] = round(time.perf_counter() - t0, 3)
+        staged["serial_sum_s"] = round(
+            staged["features_s"] + staged["inference_s"], 3
+        )
+        out["windows"] = n
+        out["staged"] = staged
+
+        timer = StageTimer()
+        t0 = time.perf_counter()
+        stream_polished = run_streaming_polish(
+            fasta, bam, params, cfg, seed=0, workers=workers,
+            batch_size=BATCH, log=quiet, timer=timer,
+        )
+        wall = time.perf_counter() - t0
+        spans = {k: round(v, 3) for k, v in sorted(timer.totals.items())}
+        streaming = {
+            "wall_s": round(wall, 3),
+            "stage_spans_s": spans,
+            "span_sum_s": round(sum(timer.totals.values()), 3),
+        }
+        out["streaming"] = streaming
+        out["overlap_efficiency"] = round(staged["serial_sum_s"] / wall, 3)
+        out["outputs_identical"] = staged_polished == stream_polished
+    return out
+
+
 def main(argv=None) -> None:
     import argparse
     import os
@@ -914,6 +1028,13 @@ def main(argv=None) -> None:
         default=None,
         help="draft length for the end-to-end pipeline suite "
         "(default: 2 Mb on TPU, 60 kb elsewhere; 0 disables)",
+    )
+    ap.add_argument(
+        "--pipeline-draft",
+        type=int,
+        default=None,
+        help="draft length for the staged-vs-streaming pipeline suite "
+        "(default: 500 kb on TPU, 60 kb elsewhere; 0 disables)",
     )
     ap.add_argument(
         "--in-process",

@@ -118,9 +118,15 @@ def _build_config(args: argparse.Namespace):
         max_delay_ms="max_delay_ms", data_root="data_root",
         ladder="ladder",  # already a tuple via the _ladder_type callback
     )
+    pipeline = over(
+        base.pipeline,
+        prefetch="prefetch", queue_regions="queue_regions",
+        max_batch_delay_ms="batch_delay_ms",
+    )
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, mesh=mesh, serve=serve,
+        pipeline=pipeline,
     )
 
 
@@ -192,13 +198,17 @@ def cmd_inference(args: argparse.Namespace) -> int:
 
     cfg = _build_config(args)
     params = _load_model_params(args.model, cfg)
+    # loader depth comes from --prefetch / PipelineConfig.prefetch; the
+    # legacy --t (reference parity: torch DataLoader workers, ref:
+    # roko/inference.py:162) still sets it when --prefetch is absent, so
+    # existing invocations keep their behavior
+    prefetch = cfg.pipeline.prefetch
+    if getattr(args, "prefetch", None) is None and args.t is not None:
+        prefetch = max(2, args.t)
     polish_to_fasta(
         args.data, params, args.out, cfg,
         batch_size=cfg.train.batch_size,  # --b layers in via _build_config
-        # reference parity: --t sized the torch DataLoader worker pool
-        # (ref: roko/inference.py:162); here the loader is a bounded
-        # prefetch-thread pipeline, so --t sets its queue depth
-        prefetch=max(2, args.t),
+        prefetch=prefetch,
         trace_dir=args.trace_dir,
     )
     print(f"wrote polished contigs to {args.out}")
@@ -232,6 +242,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--out", args.out]
     if args.e2e_draft is not None:
         argv += ["--e2e-draft", str(args.e2e_draft)]
+    if args.pipeline_draft is not None:
+        argv += ["--pipeline-draft", str(args.pipeline_draft)]
     if args.in_process:
         argv.append("--in-process")
     bench_main(argv)
@@ -239,45 +251,68 @@ def cmd_bench(args: argparse.Namespace) -> int:
 
 
 def cmd_polish(args: argparse.Namespace) -> int:
-    """One-shot draft -> polished: features + inference (+ assess when
-    --truth is given) in a single command. The reference needs two
-    manual stages plus external pomoxis for this workflow.
+    """One-shot draft -> polished. Default: the STREAMING engine
+    (roko_tpu/pipeline, docs/PIPELINE.md) — extraction workers feed the
+    device through bounded queues, votes accumulate incrementally, and
+    each contig is written as soon as its last window lands; no HDF5
+    round-trip (``--keep-hdf5`` tees one out without serialising the
+    pipeline). ``--staged`` forces the old two-stage path; byte-identical
+    output either way (tests/test_stream_pipeline.py).
 
-    On a multi-host pod each process extracts features into its own
-    process-local temp file (redundant but correct; the staged
-    `features` + `inference` commands share one HDF5 instead) and
-    inference then shards contigs across processes as usual."""
+    On a multi-host pod the staged path runs regardless (each process
+    extracts features into its own process-local temp file — redundant
+    but correct — and inference shards contigs across processes)."""
     import os
     import tempfile
 
-    from roko_tpu.features.pipeline import run_features
-    from roko_tpu.infer import polish_to_fasta
+    import jax
+
     from roko_tpu.parallel import distributed
 
     distributed.initialize()  # idempotent; needed for the pod guard
     cfg = _build_config(args)
-    if args.keep_hdf5:
-        import jax
-
-        if jax.process_count() > 1:
-            raise SystemExit(
-                "polish --keep-hdf5 is single-host only: every pod process "
-                "would write the same path on a shared filesystem. Run the "
-                "staged `features` + `inference` commands instead."
-            )
-    with tempfile.TemporaryDirectory() as td:
-        hdf5 = args.keep_hdf5 or os.path.join(td, "features.hdf5")
-        n = run_features(
-            args.ref, args.X, hdf5, workers=args.t, seed=args.seed, config=cfg
+    if args.keep_hdf5 and jax.process_count() > 1:
+        raise SystemExit(
+            "polish --keep-hdf5 is single-host only: every pod process "
+            "would write the same path on a shared filesystem. Run the "
+            "staged `features` + `inference` commands instead."
         )
-        print(f"extracted {n} windows")
+    if not args.staged and jax.process_count() == 1:
+        from roko_tpu.pipeline import run_streaming_polish
+
         params = _load_model_params(args.model, cfg)
-        polish_to_fasta(
-            hdf5, params, args.out, cfg,
-            batch_size=cfg.train.batch_size,  # --b layers in via _build_config
-            prefetch=max(2, args.t),
+        run_streaming_polish(
+            args.ref, args.X, params, cfg,
+            out_path=args.out,
+            workers=args.t,  # workers ONLY; loader depth is --prefetch
+            seed=args.seed,
+            batch_size=cfg.train.batch_size,
+            tee_hdf5=args.keep_hdf5,
+            trace_dir=args.trace_dir,
+            job_retries=args.job_retries,
+            job_timeout=args.job_timeout,
         )
         print(f"wrote polished contigs to {args.out}")
+    else:
+        from roko_tpu.features.pipeline import run_features
+        from roko_tpu.infer import polish_to_fasta
+
+        with tempfile.TemporaryDirectory() as td:
+            hdf5 = args.keep_hdf5 or os.path.join(td, "features.hdf5")
+            n = run_features(
+                args.ref, args.X, hdf5, workers=args.t, seed=args.seed,
+                config=cfg, job_retries=args.job_retries,
+                job_timeout=args.job_timeout,
+            )
+            print(f"extracted {n} windows")
+            params = _load_model_params(args.model, cfg)
+            polish_to_fasta(
+                hdf5, params, args.out, cfg,
+                batch_size=cfg.train.batch_size,  # --b via _build_config
+                prefetch=cfg.pipeline.prefetch,
+                trace_dir=args.trace_dir,
+            )
+            print(f"wrote polished contigs to {args.out}")
     if args.truth:
         # polish_to_fasta writes args.out only from process 0 (and syncs
         # before returning): on a pod, only that process can read it back
@@ -475,8 +510,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("out", help="output FASTA path")
     p.add_argument("--b", type=int, default=None, help="batch size (default 128)")
     p.add_argument(
-        "--t", type=int, default=2,
-        help="loader prefetch depth (reference parity: DataLoader workers)",
+        "--prefetch", type=int, default=None,
+        help="loader prefetch depth: batches staged ahead of the device "
+        "(default 2)",
+    )
+    p.add_argument(
+        "--t", type=int, default=None,
+        help="deprecated alias for --prefetch (reference parity: the "
+        "torch DataLoader worker count); --prefetch wins when both given",
     )
     p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace here")
     _config_arg(p)
@@ -510,6 +551,11 @@ def build_parser() -> argparse.ArgumentParser:
         "2 Mb on TPU, 60 kb elsewhere)",
     )
     p.add_argument(
+        "--pipeline-draft", type=int, default=None,
+        help="staged-vs-streaming pipeline suite draft length "
+        "(0 disables; default 500 kb on TPU, 60 kb elsewhere)",
+    )
+    p.add_argument(
         "--in-process",
         action="store_true",
         help="skip the sick-backend probe/fallback orchestration",
@@ -524,11 +570,50 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("X", help="reads-to-draft BAM")
     p.add_argument("model", help="checkpoint dir, saved params, or torch .pth")
     p.add_argument("out", help="output FASTA path")
-    p.add_argument("--t", type=int, default=1, help="feature worker processes / loader prefetch")
+    p.add_argument(
+        "--t", type=int, default=1,
+        help="feature worker processes (loader depth is --prefetch)",
+    )
     p.add_argument("--b", type=int, default=None, help="inference batch size")
+    p.add_argument(
+        "--prefetch", type=int, default=None,
+        help="device prefetch depth: batches staged ahead of the predict "
+        "step (default 2; was coupled to --t before the streaming engine)",
+    )
     p.add_argument("--seed", type=int, default=0, help="row-sampling RNG seed")
     p.add_argument("--truth", default=None, help="truth FASTA: print an assess report after polishing")
-    p.add_argument("--keep-hdf5", default=None, help="keep the intermediate features HDF5 at this path")
+    p.add_argument(
+        "--keep-hdf5", default=None,
+        help="also write the features HDF5 here (streamed as a tee — "
+        "does not serialise the pipeline)",
+    )
+    p.add_argument(
+        "--staged", action="store_true",
+        help="force the two-stage features->HDF5->inference path instead "
+        "of the default streaming engine (docs/PIPELINE.md)",
+    )
+    p.add_argument(
+        "--queue-regions", type=int, default=None,
+        help="streaming: bounded region-queue depth in region blocks "
+        "(default 8; full queue blocks extraction workers)",
+    )
+    p.add_argument(
+        "--batch-delay-ms", type=float, default=None,
+        help="streaming: flush a partial device batch at most this long "
+        "after its first window when the region queue is empty "
+        "(default 250)",
+    )
+    p.add_argument(
+        "--job-retries", type=int, default=1,
+        help="in-parent retries for a region job that raised "
+        "(as the features command)",
+    )
+    p.add_argument(
+        "--job-timeout", type=float, default=None,
+        help="seconds to wait per region result before assuming the "
+        "worker died (process pools only; as the features command)",
+    )
+    p.add_argument("--trace-dir", default=None, help="write a jax.profiler device trace here")
     _config_arg(p)
     _model_args(p)
     _mesh_args(p)

@@ -169,6 +169,26 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class PipelineConfig:
+    """Streaming polish engine (roko_tpu/pipeline, docs/PIPELINE.md):
+    feature extraction, host batching, and device inference run as one
+    overlapped pipeline instead of serial stages sharing an HDF5."""
+
+    #: bounded region-result queue depth (in region blocks, each ~a few
+    #: thousand windows). Full queue blocks the extraction workers —
+    #: explicit backpressure instead of unbounded host memory growth.
+    queue_regions: int = 8
+    #: host batcher deadline: a partially filled device batch dispatches
+    #: at most this long after its first window arrived while the region
+    #: queue is empty, so a slow extractor cannot park windows forever.
+    #: Partial batches pad to the serve ladder, never a novel shape.
+    max_batch_delay_ms: float = 250.0
+    #: device prefetch depth: batches staged ahead of the predict step
+    #: (the former overload of the features --t flag; now its own knob)
+    prefetch: int = 2
+
+
+@dataclass(frozen=True)
 class RokoConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
@@ -177,6 +197,7 @@ class RokoConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    pipeline: PipelineConfig = field(default_factory=PipelineConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -194,6 +215,7 @@ class RokoConfig:
             mesh=MeshConfig(**raw.get("mesh", {})),
             serve=ServeConfig(**{k: tuple(v) if k == "ladder" else v
                                  for k, v in raw.get("serve", {}).items()}),
+            pipeline=PipelineConfig(**raw.get("pipeline", {})),
         )
 
 

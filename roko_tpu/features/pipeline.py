@@ -3,8 +3,15 @@
 Mirrors the reference orchestration (ref: roko/features.py): contigs are
 split into 100 kb regions with 300 bp overlap; each region is processed by
 a worker (multiprocessing Pool) producing windows (and labels in training
-mode); results are buffered per contig and flushed to HDF5 every 10
-finished regions.
+mode). The fan-out itself is exposed as :func:`open_region_stream` — a
+context manager owning the pool lifecycle that yields per-region result
+blocks — with two consumers:
+
+- :func:`run_features` buffers results per contig and flushes them to an
+  HDF5 file every 10 finished regions (the staged ``features`` CLI);
+- ``roko_tpu.pipeline.run_streaming_polish`` feeds the same blocks
+  straight into the device predict loop through a bounded queue, no
+  HDF5 round-trip (docs/PIPELINE.md).
 
 Workers pick the fastest available extractor backend (C++ via
 ``roko_tpu.native`` when built, else the Python reference implementation)
@@ -16,8 +23,9 @@ from __future__ import annotations
 import contextlib
 import multiprocessing
 import os
-from dataclasses import dataclass
-from typing import Iterator, List, Optional, Sequence, Tuple
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -189,6 +197,30 @@ def _use_thread_pool(inference: bool) -> bool:
     return inference and _native_available()
 
 
+@dataclass
+class RegionStream:
+    """A live region fan-out: per-region result blocks plus the metadata
+    both consumers need before the first result lands.
+
+    ``results`` yields ``(contig, positions, examples, labels)`` per
+    region in job order (``None`` for skipped train-mode regions);
+    ``region_counts`` maps contig -> region job count, so a streaming
+    consumer can tell when a contig's last region has arrived whatever
+    order results come back in."""
+
+    refs: List[Tuple[str, str]]
+    jobs: List[_Job]
+    results: Iterator
+    inference: bool
+    region_counts: Dict[str, int] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if not self.region_counts:
+            self.region_counts = dict(
+                Counter(j.region.name for j in self.jobs)
+            )
+
+
 def run_features(
     ref_path: str,
     bam_x: str,
@@ -211,15 +243,39 @@ def run_features(
     conversion sorts in memory — fine for the modest SAMs this is for;
     genome-scale runs should hand over BAMs, which stream.
     """
-    config = config or RokoConfig()
-    with contextlib.ExitStack() as stack:
-        bam_x = _ensure_bam(bam_x, stack)
-        if bam_y is not None:
-            bam_y = _ensure_bam(bam_y, stack)
-        return _run_features_on_bams(
-            ref_path, bam_x, out_path, bam_y, workers, seed, config,
-            flush_every, log, job_retries, job_timeout,
-        )
+    import time
+
+    with open_region_stream(
+        ref_path, bam_x, bam_y=bam_y, workers=workers, seed=seed,
+        config=config, log=log, job_retries=job_retries,
+        job_timeout=job_timeout,
+    ) as stream:
+        total = 0
+        with DataWriter(out_path, stream.inference) as data:
+            data.write_contigs(stream.refs)
+            t0 = time.perf_counter()
+            done = 0
+            for result in stream.results:
+                done += 1
+                # progress heartbeat: a 5-species feature run is hours —
+                # report every flush batch (ref printed per region,
+                # roko/features.py:139; one line per flush is quieter)
+                if done % flush_every == 0:
+                    dt = time.perf_counter() - t0
+                    rate = done / max(dt, 1e-9)
+                    log(
+                        f"features: {done}/{len(stream.jobs)} regions, "
+                        f"{total} windows "
+                        f"({rate:.1f} regions/s, eta {(len(stream.jobs) - done) / max(rate, 1e-9):.0f}s)"
+                    )
+                    data.write()
+                if not result:
+                    continue
+                contig, p, x, y = result
+                data.store(contig, p, x, y)
+                total += len(p)
+            data.write()
+    return total
 
 
 def _ensure_bam(path: str, stack) -> str:
@@ -318,40 +374,55 @@ def _recovering_results(results, func, jobs, retries, timeout, log, pool=None):
         yield result
 
 
-def _run_features_on_bams(
-    ref_path, bam_x, out_path, bam_y, workers, seed, config,
-    flush_every, log, job_retries, job_timeout,
-) -> int:
-    import time
+@contextlib.contextmanager
+def open_region_stream(
+    ref_path: str,
+    bam_x: str,
+    bam_y: Optional[str] = None,
+    *,
+    workers: int = 1,
+    seed: int = 0,
+    config: Optional[RokoConfig] = None,
+    log=print,
+    job_retries: int = 1,
+    job_timeout: Optional[float] = None,
+) -> Iterator[RegionStream]:
+    """Open the region fan-out and yield a :class:`RegionStream`.
 
-    inference = bam_y is None
-    refs = read_fasta(ref_path)
+    Owns the whole extraction lifecycle: SAM->BAM conversion temp files,
+    pool creation, the failure-recovery wrapper, and pool teardown on
+    exit (terminate for process pools — after a lost-result event the
+    stream was deliberately abandoned and a hung worker would block
+    ``join`` forever; close/join for thread pools, whose threads cannot
+    die out from under the queue)."""
+    config = config or RokoConfig()
+    with contextlib.ExitStack() as stack:
+        bam_x = _ensure_bam(bam_x, stack)
+        if bam_y is not None:
+            bam_y = _ensure_bam(bam_y, stack)
+        inference = bam_y is None
+        refs = read_fasta(ref_path)
 
-    jobs: List[_Job] = []
-    for name, seq in refs:
-        for region in generate_regions(len(seq), name, config.region):
-            jobs.append(
-                _Job(
-                    bam_x=bam_x,
-                    bam_y=bam_y,
-                    region=region,
-                    seed=derive_region_seed(seed, name, region.start),
-                    config=config,
-                    ref_seq=(
-                        seq[region.start : region.end]
-                        if config.window.ref_rows > 0
-                        else None
-                    ),
-                    ref_seq_offset=region.start,
+        jobs: List[_Job] = []
+        for name, seq in refs:
+            for region in generate_regions(len(seq), name, config.region):
+                jobs.append(
+                    _Job(
+                        bam_x=bam_x,
+                        bam_y=bam_y,
+                        region=region,
+                        seed=derive_region_seed(seed, name, region.start),
+                        config=config,
+                        ref_seq=(
+                            seq[region.start : region.end]
+                            if config.window.ref_rows > 0
+                            else None
+                        ),
+                        ref_seq_offset=region.start,
+                    )
                 )
-            )
 
-    func = generate_infer if inference else generate_train
-    total = 0
-
-    with DataWriter(out_path, inference) as data:
-        data.write_contigs(refs)
-
+        func = generate_infer if inference else generate_train
         is_thread_pool = False
         if workers <= 1:
             results = map(func, jobs)
@@ -382,42 +453,15 @@ def _run_features_on_bams(
             results, func, jobs, job_retries, job_timeout, log,
             pool=None if is_thread_pool else pool,
         )
-
-        t0 = time.perf_counter()
         try:
-            done = 0
-            for result in results:
-                done += 1
-                # progress heartbeat: a 5-species feature run is hours —
-                # report every flush batch (ref printed per region,
-                # roko/features.py:139; one line per flush is quieter)
-                if done % flush_every == 0:
-                    dt = time.perf_counter() - t0
-                    rate = done / max(dt, 1e-9)
-                    log(
-                        f"features: {done}/{len(jobs)} regions, "
-                        f"{total} windows "
-                        f"({rate:.1f} regions/s, eta {(len(jobs) - done) / max(rate, 1e-9):.0f}s)"
-                    )
-                    data.write()
-                if not result:
-                    continue
-                contig, p, x, y = result
-                data.store(contig, p, x, y)
-                total += len(p)
-            data.write()
+            yield RegionStream(
+                refs=refs, jobs=jobs, results=results, inference=inference
+            )
         finally:
             if pool is not None:
                 if is_thread_pool:
-                    # threads can't be killed; close/join is safe (no
-                    # thread can die out from under the queue)
                     pool.close()
                     pool.join()
                 else:
-                    # terminate, not close/join: after a lost-result
-                    # event the stream was deliberately abandoned, and a
-                    # hung (not dead) worker would block join forever
                     pool.terminate()
                     pool.join()
-
-    return total

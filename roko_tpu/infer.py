@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -66,6 +66,30 @@ def pad_windows(x: np.ndarray, batch_size: int) -> np.ndarray:
         raise ValueError(f"batch of {n} windows exceeds pad target {batch_size}")
     pad = batch_size - n
     return np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
+
+
+def tail_rungs(
+    ladder: Sequence[int], batch_size: int, dp: int
+) -> Tuple[int, ...]:
+    """Padded batch sizes available to a SHORT (tail or partial) batch:
+    the serve ladder's rungs that fit under ``batch_size`` and divide
+    the dp mesh axis, plus ``batch_size`` itself. Steady-state batches
+    always dispatch at ``batch_size`` (one executable); a short batch
+    pads only to the smallest rung that fits, so the final partial
+    batch of a run stops paying for ``batch_size - n`` wasted rows at
+    the cost of at most ``len(rungs) - 1`` extra one-off compiles."""
+    rungs = {r for r in ladder if 0 < r < batch_size and r % dp == 0}
+    rungs.add(batch_size)
+    return tuple(sorted(rungs))
+
+
+def rung_for(rungs: Sequence[int], n: int) -> int:
+    """Smallest rung >= n (the top rung caps it; callers never exceed
+    the top rung because it is their full batch size)."""
+    for r in rungs:
+        if n <= r:
+            return r
+    return rungs[-1]
 
 
 def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
@@ -376,11 +400,17 @@ def run_inference(
         else VoteBoard(contigs)
     )
     timer = StageTimer()
+    # every full batch dispatches at batch_size (one steady-state
+    # executable); the single short TAIL batch pads only to the nearest
+    # serve-ladder rung instead of all the way up to batch_size, so a
+    # 1-window tail on a --b 2048 run stops paying 2047 rows of wasted
+    # compute for one extra (one-off, never steady-state) compile
+    rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
 
     def place(item):
         names, positions, x, release = item
         n = len(names)
-        x = pad_windows(x, batch_size)  # fixed shapes keep one executable
+        x = pad_windows(x, rung_for(rungs, n))
         # device_put dispatches asynchronously, so timing it here would
         # read ~0 and misattribute the transfer to the predict span —
         # transfer cost shows up inside "predict+d2h"

@@ -40,12 +40,20 @@ def read_fasta(path: str) -> List[Tuple[str, str]]:
     return list(iter_fasta(path))
 
 
+def write_fasta_record(fh, name: str, seq: str, line_width: int = 80) -> None:
+    """One record in this module's canonical layout. The single source
+    of the on-disk format: the streaming engine's incremental writer
+    (roko_tpu/pipeline) promises byte-identity with :func:`write_fasta`
+    and keeps it by calling this."""
+    fh.write(f">{name}\n")
+    for i in range(0, len(seq), line_width):
+        fh.write(seq[i : i + line_width])
+        fh.write("\n")
+
+
 def write_fasta(
     path: str, records: Sequence[Tuple[str, str]], line_width: int = 80
 ) -> None:
     with open(path, "w") as fh:
         for name, seq in records:
-            fh.write(f">{name}\n")
-            for i in range(0, len(seq), line_width):
-                fh.write(seq[i : i + line_width])
-                fh.write("\n")
+            write_fasta_record(fh, name, seq, line_width)

@@ -1,0 +1,4 @@
+"""Streaming polish pipeline: extraction, batching, and device
+inference as one overlapped pipeline (docs/PIPELINE.md)."""
+
+from roko_tpu.pipeline.stream import run_streaming_polish  # noqa: F401

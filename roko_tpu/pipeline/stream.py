@@ -1,0 +1,528 @@
+"""Streaming polish engine: FASTA+BAM -> polished FASTA as ONE
+overlapped pipeline (docs/PIPELINE.md).
+
+The staged path (``features`` then ``inference``) is strictly serial:
+every window is written to an HDF5 file and read back before the first
+prediction dispatches, so extractor cores and the accelerator take
+turns idling (BENCH end_to_end.stages is a plain sum). This engine
+runs the same three stages concurrently, t5x/seqio-style (PAPERS.md):
+a host-side producer pipeline feeds the device through bounded buffers.
+
+::
+
+    extraction workers (features.open_region_stream Pool/ThreadPool)
+        │ per-region (positions, examples) blocks
+        ▼
+    producer thread ── bounded queue.Queue(queue_regions) ──┐  backpressure:
+        │ optional tee -> DataWriter (--keep-hdf5)          │  full queue
+        ▼                                                   │  blocks workers
+    batcher generator (cut to batch_size, deadline flush,
+        pad partials to the serve ladder — no novel shapes)
+        │ runs inside prefetch_to_device's stage thread
+        ▼
+    device predict (jit, one-deep software pipeline)
+        │ preds
+        ▼
+    VoteBoard.add (incremental)  ──  contig's last window voted
+                                       └─> stitch + FASTA write NOW
+
+Failure propagation: a worker exception travels through the region
+queue as an ``("error", exc)`` item and re-raises in the caller —
+never a silent deadlock. Abandoning the consumer (exception in the
+predict loop, generator close) sets a stop event that every producer
+``put`` polls, so no thread is left parked on a full queue.
+
+Output identity: votes are order-independent sums and the predict step
+is batch-padding invariant (tests/test_infer.py), so the streamed
+FASTA is byte-identical to the staged path's on the same inputs —
+asserted in tests/test_stream_pipeline.py, including out-of-order
+region arrival.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import queue
+import threading
+import time
+from collections import Counter, deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from roko_tpu import constants as C
+from roko_tpu.config import RokoConfig
+from roko_tpu.data.hdf5 import DataWriter
+from roko_tpu.features.pipeline import open_region_stream
+from roko_tpu.io.fasta import write_fasta_record
+from roko_tpu.infer import (
+    VoteBoard,
+    make_predict_step,
+    pad_windows,
+    rung_for,
+    tail_rungs,
+)
+from roko_tpu.models.model import RokoModel
+from roko_tpu.parallel.mesh import (
+    AXIS_DP,
+    data_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+from roko_tpu.training.data import prefetch_to_device
+from roko_tpu.utils.profiling import StageTimer, device_trace
+
+Params = Dict[str, Any]
+
+# queue item tags (first tuple element)
+_BLOCK, _DONE, _ERROR, _END = "block", "done", "error", "end"
+
+
+class _OrderedFastaWriter:
+    """Streams polished contigs to a FASTA file, accepting completions
+    in ANY order but writing in a fixed canonical order (sorted names —
+    what the staged path's ``load_contigs`` h5py iteration produces, so
+    the streamed file is byte-identical to ``polish_to_fasta``'s): a
+    contig is written the moment it and every contig ahead of it in the
+    order are done, and held in RAM only until then."""
+
+    def __init__(self, path: str, order: List[str], line_width: int = 80):
+        self.path = path
+        self._order = list(order)
+        self._line_width = line_width
+        self._next = 0
+        self._ready: Dict[str, str] = {}
+        self._fh = open(path, "w")
+
+    def add(self, name: str, seq: str) -> None:
+        self._ready[name] = seq
+        while (
+            self._next < len(self._order)
+            and self._order[self._next] in self._ready
+        ):
+            cur = self._order[self._next]
+            write_fasta_record(
+                self._fh, cur, self._ready.pop(cur), self._line_width
+            )
+            self._next += 1
+        self._fh.flush()
+
+    def __enter__(self) -> "_OrderedFastaWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        self._fh.close()
+        if exc_type is not None:
+            # a failed run must not leave a valid-looking but truncated
+            # FASTA behind — the staged path writes the file only after
+            # full success, and resume-style pipelines gate on existence
+            with contextlib.suppress(OSError):
+                os.unlink(self.path)
+
+
+class _RegionProducer:
+    """Thread that drains the extraction fan-out into the bounded region
+    queue (and optionally tees every block to a features HDF5).
+
+    Per-contig region counts come from the source up front, so the
+    producer can emit a ``("done", contig, total_windows)`` notice the
+    moment a contig's LAST region block has been queued — whatever
+    order regions complete in. The consumer stitches on that notice as
+    soon as the windows it promises have been voted."""
+
+    def __init__(
+        self,
+        source,
+        q: "queue.Queue",
+        timer: StageTimer,
+        tee: Optional[DataWriter] = None,
+        flush_every: int = 10,
+    ):
+        self.source = source
+        self.q = q
+        self.timer = timer
+        self.tee = tee
+        self.flush_every = flush_every
+        self.stop = threading.Event()
+        self.thread = threading.Thread(
+            target=self._run, name="roko-stream-extract", daemon=True
+        )
+
+    def start(self) -> None:
+        self.thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up when the consumer is gone —
+        an abandoned engine must not leave this thread parked on a
+        full queue forever."""
+        while not self.stop.is_set():
+            try:
+                self.q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        remaining = dict(self.source.region_counts)
+        totals: Dict[str, int] = {}
+        regions_done = 0
+        try:
+            it = iter(self.source.results)
+            while True:
+                # the span measures time BLOCKED on extraction workers;
+                # under real overlap it runs concurrently with the
+                # device predict spans, so sum(spans) > wall time
+                with self.timer("extract"):
+                    try:
+                        result = next(it)
+                    except StopIteration:
+                        break
+                if self.stop.is_set():
+                    return
+                contig, pos, x, _ = result
+                if self.tee is not None:
+                    with self.timer("tee_hdf5"):
+                        self.tee.store(contig, pos, x, None)
+                        regions_done += 1
+                        if regions_done % self.flush_every == 0:
+                            self.tee.write()
+                n = len(pos)
+                if n:
+                    totals[contig] = totals.get(contig, 0) + n
+                    if not self._put((_BLOCK, contig, pos, x)):
+                        return
+                left = remaining.get(contig, 1) - 1
+                remaining[contig] = left
+                if left == 0:
+                    if not self._put((_DONE, contig, totals.get(contig, 0))):
+                        return
+        except BaseException as e:  # propagate to the consumer side
+            self._put((_ERROR, e))
+            return
+        self._put((_END, None))
+
+
+def _device_batches(
+    q: "queue.Queue",
+    batch_size: int,
+    deadline_s: float,
+    stop: threading.Event,
+) -> Iterator[tuple]:
+    """Cut ``(names, positions, examples, n, completions)`` device
+    batches from the region queue.
+
+    Full batches are exactly ``batch_size`` windows. A PARTIAL batch is
+    flushed when the queue has been empty for ``deadline_s`` since its
+    first window arrived — the extractor is the bottleneck right then,
+    and parking windows to chase a full batch would idle the device for
+    nothing (the caller pads partials to the serve ladder, so no novel
+    shape reaches the compiler). ``completions`` carries the
+    ``("done", ...)`` notices consumed since the previous yield."""
+    pending: deque = deque()  # [contig, positions, examples, offset]
+    total = 0
+    completions: List[Tuple[str, int]] = []
+    first_t = 0.0
+    end = False
+
+    def cut(size: int) -> tuple:
+        nonlocal total
+        names: List[str] = []
+        ps: List[np.ndarray] = []
+        xs: List[np.ndarray] = []
+        need = size
+        while need:
+            rec = pending[0]
+            contig, pos, x, off = rec
+            take = min(need, len(pos) - off)
+            names.extend([contig] * take)
+            ps.append(pos[off : off + take])
+            xs.append(x[off : off + take])
+            if off + take == len(pos):
+                pending.popleft()
+            else:
+                rec[3] = off + take
+            need -= take
+        total -= size
+        p = ps[0] if len(ps) == 1 else np.concatenate(ps)
+        xx = xs[0] if len(xs) == 1 else np.concatenate(xs)
+        comps, completions[:] = list(completions), []
+        return names, p, xx, size, comps
+
+    while True:
+        while total < batch_size and not end:
+            # two phases (the serve MicroBatcher's shape): already-queued
+            # blocks coalesce unconditionally — even when the deadline
+            # expired while the consumer was busy voting, a waiting
+            # backlog must still form full batches or device throughput
+            # collapses into a stream of under-filled padded dispatches;
+            # the deadline only bounds how long a partial batch waits
+            # for NEW arrivals
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                timeout = 0.25
+                if total:
+                    remaining = deadline_s - (time.perf_counter() - first_t)
+                    if remaining <= 0:
+                        break  # deadline: flush the partial batch
+                    timeout = min(remaining, 0.25)
+                try:
+                    item = q.get(timeout=timeout)
+                except queue.Empty:
+                    if stop.is_set():
+                        return
+                    if completions and not total:
+                        # don't sit on a contig-complete notice while
+                        # the extractor grinds an unrelated region: the
+                        # consumer stitches + writes that contig NOW
+                        comps, completions = completions, []
+                        yield [], None, None, 0, comps
+                    continue
+            tag = item[0]
+            if tag == _BLOCK:
+                if total == 0:
+                    first_t = time.perf_counter()
+                pending.append([item[1], item[2], item[3], 0])
+                total += len(item[2])
+            elif tag == _DONE:
+                completions.append((item[1], item[2]))
+            elif tag == _ERROR:
+                raise item[1]
+            else:  # _END
+                end = True
+        if total:
+            yield cut(batch_size if total >= batch_size else total)
+            if total:
+                # leftover windows inherit a fresh deadline (approximate
+                # age — the deadline is a latency bound, not a contract)
+                first_t = time.perf_counter()
+            continue
+        if completions:
+            comps, completions = completions, []
+            yield [], None, None, 0, comps
+        if end:
+            return
+
+
+def run_streaming_polish(
+    ref_path: Optional[str],
+    bam_x: Optional[str],
+    params: Params,
+    cfg: Optional[RokoConfig] = None,
+    *,
+    out_path: Optional[str] = None,
+    workers: int = 1,
+    seed: int = 0,
+    batch_size: int = 128,
+    mesh: Optional[Mesh] = None,
+    prefetch: Optional[int] = None,
+    queue_regions: Optional[int] = None,
+    batch_delay_ms: Optional[float] = None,
+    tee_hdf5: Optional[str] = None,
+    trace_dir: Optional[str] = None,
+    log=print,
+    timer: Optional[StageTimer] = None,
+    vote_sparse_threshold: Optional[int] = None,
+    job_retries: int = 1,
+    job_timeout: Optional[float] = None,
+    region_source=None,
+) -> Dict[str, str]:
+    """Polish ``ref_path``+``bam_x`` to ``{contig: sequence}`` with
+    feature extraction, host batching, and device inference overlapped;
+    writes ``out_path`` incrementally (each contig lands as soon as its
+    last window is voted) when given, and tees the extracted windows to
+    a features HDF5 at ``tee_hdf5`` when given (the ``--keep-hdf5``
+    path — same schema the staged ``features`` command writes).
+
+    ``region_source`` overrides the extraction fan-out with any object
+    exposing ``refs``, ``region_counts`` and ``results`` (tests inject
+    out-of-order and faulting sources through it). Single-host only:
+    pods keep the staged contig-sharded path (``polish_to_fasta``)."""
+    if jax.process_count() > 1:
+        raise RuntimeError(
+            "streaming polish is single-host; run the staged features + "
+            "inference commands (contig-sharded) on a pod"
+        )
+    cfg = cfg or RokoConfig()
+    pcfg = cfg.pipeline
+    prefetch = pcfg.prefetch if prefetch is None else prefetch
+    queue_regions = (
+        pcfg.queue_regions if queue_regions is None else queue_regions
+    )
+    deadline_s = (
+        pcfg.max_batch_delay_ms if batch_delay_ms is None else batch_delay_ms
+    ) / 1e3
+    mesh = mesh or make_mesh(cfg.mesh)
+    dp = mesh.shape[AXIS_DP]
+    if batch_size % dp:
+        raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
+
+    model = RokoModel(cfg.model)
+    params = jax.device_put(params, replicated_sharding(mesh))
+    predict = make_predict_step(model, mesh)
+    sharding = data_sharding(mesh)
+    # partial/tail batches pad to the serve ladder (plus batch_size), so
+    # deadline flushes never hand the compiler a novel shape
+    rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
+    timer = timer if timer is not None else StageTimer()
+
+    with contextlib.ExitStack() as stack:
+        if region_source is None:
+            region_source = stack.enter_context(
+                open_region_stream(
+                    ref_path, bam_x, workers=workers, seed=seed, config=cfg,
+                    log=log, job_retries=job_retries, job_timeout=job_timeout,
+                )
+            )
+        contigs = {name: seq for name, seq in region_source.refs}
+        board = (
+            VoteBoard(contigs, sparse_threshold=vote_sparse_threshold)
+            if vote_sparse_threshold is not None
+            else VoteBoard(contigs)
+        )
+        writer = (
+            stack.enter_context(
+                _OrderedFastaWriter(out_path, sorted(contigs))
+            )
+            if out_path
+            else None
+        )
+
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, queue_regions))
+        stop = threading.Event()
+        producer = _RegionProducer(region_source, q, timer)
+        # the tee is NOT ExitStack-managed: only the producer thread
+        # touches the h5py handle once that thread starts, so it must
+        # be closed only after the thread is confirmed dead (closing an
+        # h5py file under a live writer corrupts it — see the finally).
+        # Opened last so no other setup failure can strand the handle.
+        tee = None
+        if tee_hdf5:
+            tee = DataWriter(tee_hdf5, infer=True)
+            tee.__enter__()
+            try:
+                tee.write_contigs(region_source.refs)
+            except BaseException:
+                tee.__exit__(None, None, None)
+                raise
+            producer.tee = tee
+
+        # contig -> final window count, known once its last region has
+        # been extracted ("done" notices); zero-region contigs (shorter
+        # than any region, impossible today, or zero-length) are final
+        # from the start and stitch to the unchanged draft immediately
+        final_counts: Dict[str, int] = {
+            name: 0
+            for name in contigs
+            if region_source.region_counts.get(name, 0) == 0
+        }
+        voted: Dict[str, int] = {name: 0 for name in contigs}
+        polished: Dict[str, str] = {}
+
+        def finish_ready() -> None:
+            # final_counts only holds extraction-complete, not-yet-
+            # stitched contigs (entries leave on stitch), so this scan
+            # is O(awaiting-stitch) per batch — near-empty in steady
+            # state — not O(all contigs) on the vote hot path
+            done = [
+                name for name, total_w in final_counts.items()
+                if voted[name] >= total_w
+            ]
+            for name in done:
+                del final_counts[name]
+                with timer("stitch"):
+                    seq = board.stitch(name)
+                polished[name] = seq
+                if writer is not None:
+                    with timer("write_fasta"):
+                        writer.add(name, seq)
+
+        def place(item):
+            names, pos, x, n, comps = item
+            if n == 0:
+                return names, pos, None, 0, comps
+            x = pad_windows(x, rung_for(rungs, n))
+            # device_put dispatches asynchronously; transfer cost shows
+            # up inside "predict+d2h" (same attribution as run_inference)
+            return names, pos, jax.device_put(x, sharding), n, comps
+
+        def drain(entry) -> int:
+            names, pos, fut, n, comps = entry
+            if n:
+                with timer("predict+d2h"):
+                    preds = np.asarray(jax.device_get(fut))[:n]
+                with timer("vote"):
+                    board.add(names, pos, preds)
+                for name, cnt in Counter(names).items():
+                    voted[name] += cnt
+            for name, total_w in comps:
+                final_counts[name] = total_w
+            finish_ready()
+            return n
+
+        n_windows = 0
+        t0 = time.perf_counter()
+        try:
+            finish_ready()  # zero-region contigs stitch immediately
+            producer.start()
+            with device_trace(trace_dir):
+                # one-deep software pipeline (as run_inference): dispatch
+                # batch k+1's predict before blocking on batch k's fetch
+                # + vote, so host voting overlaps device compute
+                pending = None
+                for item in prefetch_to_device(
+                    _device_batches(q, batch_size, deadline_s, stop),
+                    prefetch,
+                    place,
+                ):
+                    names, pos, dev, n, comps = item
+                    fut = predict(params, dev) if n else None
+                    if pending is not None:
+                        n_windows += drain(pending)
+                    pending = (names, pos, fut, n, comps)
+                if pending is not None:
+                    n_windows += drain(pending)
+        finally:
+            stop.set()
+            producer.stop.set()
+            # unblock a producer parked on a full queue, then reap it
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            if producer.thread.ident is not None:  # start() was reached
+                producer.thread.join(timeout=5.0)
+                if producer.thread.is_alive():
+                    # a long tee flush can outlive the first grace
+                    # period; a thread hung in the extraction pool
+                    # cannot (its _put gives up 0.1s after stop) —
+                    # wait it out once
+                    producer.thread.join(timeout=25.0)
+            if tee is not None:
+                if not producer.thread.is_alive():
+                    tee.__exit__(None, None, None)
+                # else: leave the handle open — closing h5py under a
+                # live writer thread corrupts the file, and the error
+                # that abandoned the loop is already propagating
+
+        missing = [n for n in contigs if n not in polished]
+        if missing:  # pragma: no cover - defensive: every clean end
+            # delivers a done-notice per contig before _END
+            raise RuntimeError(
+                f"streaming polish ended with unfinished contigs: "
+                f"{missing[:5]}{'...' if len(missing) > 5 else ''}"
+            )
+    dt = time.perf_counter() - t0
+    log(f"extracted {n_windows} windows")
+    log(
+        f"streaming polish: {n_windows} windows in {dt:.1f}s "
+        f"({n_windows / max(dt, 1e-9):.0f} windows/s, "
+        f"{n_windows * C.WINDOW_STRIDE / max(dt, 1e-9):.0f} bases/s)"
+    )
+    timer.report(log)
+    return {name: polished[name] for name in sorted(polished)}

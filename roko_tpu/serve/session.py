@@ -25,7 +25,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from roko_tpu.config import RokoConfig
-from roko_tpu.infer import make_predict_step, pad_windows
+from roko_tpu.infer import make_predict_step, pad_windows, rung_for
 from roko_tpu.models.model import RokoModel
 from roko_tpu.parallel.mesh import (
     AXIS_DP,
@@ -102,11 +102,10 @@ class PolishSession:
 
     def rung_for(self, n: int) -> int:
         """Smallest ladder rung >= n (top rung when none fits; callers
-        chunk at the top rung first)."""
-        for rung in self.ladder:
-            if n <= rung:
-                return rung
-        return self.ladder[-1]
+        chunk at the top rung first). One rule for every ladder user:
+        delegates to ``infer.rung_for`` (the batch tail and streaming
+        batcher share it)."""
+        return rung_for(self.ladder, n)
 
     def padded_size(self, n: int) -> int:
         """Total padded rows ``predict`` will dispatch for an n-window

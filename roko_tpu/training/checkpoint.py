@@ -36,6 +36,12 @@ class CheckpointManager:
                 max_to_keep=keep,
                 best_fn=lambda m: m["val_acc"],
                 best_mode="max",
+                # the makedirs above already created the root; letting
+                # orbax create it would run a sync_global_processes
+                # barrier that needs psum collectives — unavailable on
+                # the CPU backend's multi-process mode (pods on CPU are
+                # a test configuration, tests/test_multiprocess.py)
+                create=False,
             ),
         )
         self._ckptr = ocp.StandardCheckpointer()
@@ -62,7 +68,11 @@ class CheckpointManager:
         if like is not None:
             target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
             return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
-        return self._mgr.restore(step)
+        # targetless restore still needs explicit args: a FRESH manager
+        # (load_params opens one per call) has no handler registered for
+        # the "default" item and a bare restore() raises KeyError on
+        # orbax >= 0.7 (the registry is only populated by a save)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
 
     def restore_latest(self, like=None) -> Optional[Dict[str, Any]]:
         if os.path.exists(self._latest_path):
@@ -85,12 +95,21 @@ class CheckpointManager:
         if os.path.exists(self._latest_path):
             self._ckptr.wait_until_finished()
             meta = self._ckptr.metadata(self._latest_path)
-            return set(meta.item_metadata.tree.keys())
+            # orbax < 0.7 wrapped the tree (meta.item_metadata.tree);
+            # 0.7 returns the metadata tree itself as a plain dict.
+            # Two separate getattr steps: the fallback at each level
+            # must be the value from the level above, not the original
+            # wrapper, or an item_metadata-without-tree shape resolves
+            # back to the wrapper and .keys() explodes
+            inner = getattr(meta, "item_metadata", meta)
+            tree = getattr(inner, "tree", inner)
+            return set(tree.keys())
         step = self._mgr.latest_step()
         if step is None:
             return None
         meta = self._mgr.item_metadata(step)
-        tree = getattr(getattr(meta, "item_metadata", meta), "tree", meta)
+        inner = getattr(meta, "item_metadata", meta)
+        tree = getattr(inner, "tree", inner)
         return set(tree.keys())
 
     def best_step(self) -> Optional[int]:

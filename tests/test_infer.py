@@ -10,7 +10,13 @@ import jax
 from roko_tpu import constants as C
 from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig
 from roko_tpu.data.hdf5 import DataWriter
-from roko_tpu.infer import VoteBoard, make_predict_step, run_inference
+from roko_tpu.infer import (
+    VoteBoard,
+    make_predict_step,
+    run_inference,
+    rung_for,
+    tail_rungs,
+)
 from roko_tpu.models.model import RokoModel
 from roko_tpu.parallel.mesh import make_mesh
 
@@ -188,6 +194,67 @@ def test_run_inference_sparse_board_matches_dense(rng, tmp_path):
         vote_sparse_threshold=0,
     )
     assert dense == sparse
+
+
+def test_tail_rungs_reuse_serve_ladder():
+    """The batch loop's final partial batch pads to the nearest serve
+    ladder rung, not all the way to batch_size (ISSUE satellite) —
+    steady-state full batches still dispatch at exactly batch_size."""
+    rungs = tail_rungs((32, 128, 512), batch_size=512, dp=8)
+    assert rungs == (32, 128, 512)
+    assert rung_for(rungs, 1) == 32
+    assert rung_for(rungs, 32) == 32
+    assert rung_for(rungs, 33) == 128
+    assert rung_for(rungs, 200) == 512
+    assert rung_for(rungs, 512) == 512
+    # rungs above batch_size are useless for a tail and are dropped;
+    # batch_size itself is always present
+    assert tail_rungs((32, 128, 512), batch_size=64, dp=8) == (32, 64)
+    # rungs that don't divide the dp mesh axis can't shard — dropped
+    assert tail_rungs((24, 128), batch_size=512, dp=16) == (128, 512)
+    # tiny test batches (below every rung) keep their old behavior:
+    # pad to batch_size, nothing else compiles
+    assert tail_rungs((32, 128, 512), batch_size=8, dp=8) == (8,)
+
+
+def test_run_inference_tail_rung_short_final_batch(rng, tmp_path):
+    """End-to-end through run_inference with a batch_size above the
+    window count and a ladder rung below it: the tail pads to the rung
+    and the output matches the rung-free path byte for byte."""
+    import dataclasses
+
+    from roko_tpu.config import ServeConfig
+
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    n, B, W = 7, 200, 90
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, B, W)).astype(np.uint8)
+    positions = []
+    for i in range(n):
+        start = i * C.WINDOW_STRIDE
+        pos = np.stack(
+            [np.arange(start, start + W), np.zeros(W, np.int64)], axis=1
+        )
+        positions.append(pos)
+    path = tmp_path / "tail.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", positions, list(X), None)
+
+    cfg_small_rung = RokoConfig(
+        model=TINY, mesh=MeshConfig(dp=8),
+        serve=ServeConfig(ladder=(8, 64)),
+    )
+    cfg_no_rung = dataclasses.replace(
+        cfg_small_rung, serve=ServeConfig(ladder=(64,))
+    )
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    with_rung = run_inference(
+        str(path), params, cfg_small_rung, batch_size=64, log=lambda s: None
+    )
+    without = run_inference(
+        str(path), params, cfg_no_rung, batch_size=64, log=lambda s: None
+    )
+    assert with_rung == without
 
 
 def test_predict_step_batch_invariance(rng):

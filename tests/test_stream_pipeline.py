@@ -1,0 +1,332 @@
+"""Streaming polish engine tests (roko_tpu/pipeline, docs/PIPELINE.md).
+
+The load-bearing guarantees, each asserted here:
+
+- the streamed FASTA is **byte-identical** to the staged
+  features -> HDF5 -> inference path on the same inputs/params —
+  including when region results arrive out of region order, and when a
+  slow extractor forces deadline-flushed partial batches;
+- the ``--keep-hdf5`` tee writes a features file the staged inference
+  path polishes to the same bytes;
+- the bounded region queue exerts real backpressure (a stalled
+  consumer blocks the producer instead of growing the queue), and a
+  worker exception propagates out of the engine instead of
+  deadlocking it.
+"""
+
+import queue
+import time
+from types import SimpleNamespace
+
+import pytest
+
+import jax
+
+from roko_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    PipelineConfig,
+    RegionConfig,
+    RokoConfig,
+)
+from roko_tpu.features.pipeline import open_region_stream, run_features
+from roko_tpu.infer import polish_to_fasta, run_inference
+from roko_tpu.io.bam import write_sorted_bam
+from roko_tpu.io.fasta import read_fasta, write_fasta
+from roko_tpu.models.model import RokoModel
+from roko_tpu.pipeline import run_streaming_polish
+from roko_tpu.pipeline.stream import (
+    _OrderedFastaWriter,
+    _RegionProducer,
+)
+from roko_tpu.utils.profiling import StageTimer
+
+from .helpers import random_seq, simulate_reads
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+@pytest.fixture(scope="module")
+def project(tmp_path_factory):
+    """Two-contig sim project with MULTI-REGION contigs (small region
+    size), a tiny model, and the staged path's reference output."""
+    import random
+
+    root = tmp_path_factory.mktemp("stream")
+    rng = random.Random(7)
+    # names chosen so draft-FASTA order != sorted order (the streamed
+    # writer must reproduce the staged path's sorted-name layout)
+    drafts = [("zulu", random_seq(rng, 3000)), ("alpha", random_seq(rng, 2400))]
+    fasta = str(root / "draft.fasta")
+    write_fasta(fasta, drafts)
+    refs = [(n, len(s)) for n, s in drafts]
+    reads = []
+    for tid, (_, seq) in enumerate(drafts):
+        reads += simulate_reads(rng, seq, tid, coverage=10, read_len=300)
+    bam = str(root / "reads.bam")
+    write_sorted_bam(bam, refs, reads)
+
+    cfg = RokoConfig(
+        model=TINY,
+        mesh=MeshConfig(dp=8),
+        region=RegionConfig(size=1200, overlap=100),
+    )
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+
+    h5 = str(root / "features.hdf5")
+    n = run_features(fasta, bam, h5, seed=5, config=cfg, log=lambda *a: None)
+    assert n > 50
+    staged_fa = str(root / "staged.fasta")
+    polish_to_fasta(h5, params, staged_fa, cfg, batch_size=16,
+                    log=lambda *a: None)
+    staged_bytes = open(staged_fa, "rb").read()
+    staged = run_inference(h5, params, cfg, batch_size=16,
+                           log=lambda *a: None)
+    return SimpleNamespace(
+        root=root, fasta=fasta, bam=bam, cfg=cfg, params=params,
+        windows=n, staged=staged, staged_bytes=staged_bytes,
+    )
+
+
+def test_streaming_matches_staged_byte_identical(project, tmp_path):
+    """The tentpole acceptance: streaming polish == staged polish, to
+    the byte, and the --keep-hdf5 tee round-trips through the staged
+    inference path to the same bytes again."""
+    out = str(tmp_path / "stream.fasta")
+    tee = str(tmp_path / "tee.hdf5")
+    timer = StageTimer()
+    polished = run_streaming_polish(
+        project.fasta, project.bam, project.params, project.cfg,
+        out_path=out, seed=5, batch_size=16, workers=2, tee_hdf5=tee,
+        log=lambda *a: None, timer=timer,
+    )
+    assert polished == project.staged
+    assert open(out, "rb").read() == project.staged_bytes
+    # the instrumented spans cover every pipeline stage
+    assert {"extract", "predict+d2h", "vote", "stitch"} <= set(timer.totals)
+    assert "tee_hdf5" in timer.totals
+    # the tee is a faithful features file: the STAGED path polishes it
+    # to identical bytes (--keep-hdf5 contract)
+    tee_fa = str(tmp_path / "tee.fasta")
+    polish_to_fasta(tee, project.params, tee_fa, project.cfg,
+                    batch_size=16, log=lambda *a: None)
+    assert open(tee_fa, "rb").read() == project.staged_bytes
+
+
+def _materialised(project):
+    """Snapshot the region fan-out (refs, region_counts, result list)
+    so tests can reorder, slow down, or truncate delivery."""
+    with open_region_stream(
+        project.fasta, project.bam, workers=1, seed=5, config=project.cfg,
+        log=lambda *a: None,
+    ) as stream:
+        return stream.refs, dict(stream.region_counts), list(stream.results)
+
+
+def _source(refs, counts, results):
+    return SimpleNamespace(
+        refs=refs, region_counts=counts, results=iter(results)
+    )
+
+
+def test_streaming_out_of_region_order(project, tmp_path):
+    """A contig whose windows arrive out of region order still stitches
+    and writes byte-identically: votes are order-independent sums and
+    completion is counted per contig, not assumed in-order (ISSUE
+    acceptance)."""
+    refs, counts, results = _materialised(project)
+    assert len(results) >= 4  # the fixture really is multi-region
+    # reverse = every contig's regions arrive out of order AND the
+    # contigs interleave adversarially
+    out = str(tmp_path / "ooo.fasta")
+    polished = run_streaming_polish(
+        None, None, project.params, project.cfg, out_path=out,
+        batch_size=16, log=lambda *a: None,
+        region_source=_source(refs, counts, list(reversed(results))),
+    )
+    assert polished == project.staged
+    assert open(out, "rb").read() == project.staged_bytes
+
+
+def test_streaming_deadline_flush_partial_batches(project, tmp_path):
+    """A slow extractor (batch never fills before the deadline) forces
+    partial rung-padded dispatches; output is still byte-identical."""
+    refs, counts, results = _materialised(project)
+
+    def slow_results():
+        for r in results:
+            time.sleep(0.05)
+            yield r
+
+    out = str(tmp_path / "slow.fasta")
+    polished = run_streaming_polish(
+        None, None, project.params, project.cfg, out_path=out,
+        # batch far larger than any region block + a tiny deadline:
+        # every dispatch is a deadline flush
+        batch_size=512, batch_delay_ms=10.0,
+        log=lambda *a: None,
+        region_source=SimpleNamespace(
+            refs=refs, region_counts=counts, results=slow_results()
+        ),
+    )
+    assert polished == project.staged
+    assert open(out, "rb").read() == project.staged_bytes
+
+
+def test_backpressure_blocks_producer(project):
+    """A stalled consumer BLOCKS the extraction producer at the bounded
+    queue instead of buffering windows without limit (ISSUE satellite):
+    with queue depth Q, at most Q blocks are queued plus one the
+    producer holds in hand."""
+    refs, counts, results = _materialised(project)
+    n = len(results)
+    pulled = []
+
+    def counting():
+        for r in results:
+            pulled.append(r[0])
+            yield r
+
+    src = SimpleNamespace(
+        refs=refs, region_counts=counts, results=counting(),
+    )
+    depth = 2
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    producer = _RegionProducer(src, q, StageTimer())
+    producer.start()
+    deadline = time.monotonic() + 5.0
+    while len(pulled) < depth + 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.3)  # would keep growing if the queue were unbounded
+    assert len(pulled) == depth + 1, (len(pulled), n)
+    assert n > depth + 1  # the stall happened mid-stream, not at the end
+    # draining the queue releases the producer through the remainder
+    drained = 0
+    while producer.thread.is_alive() or not q.empty():
+        try:
+            q.get(timeout=1.0)
+            drained += 1
+        except queue.Empty:
+            break
+    producer.thread.join(timeout=5.0)
+    assert not producer.thread.is_alive()
+    assert len(pulled) == n
+    assert drained > depth
+
+
+def test_worker_exception_propagates(project, tmp_path):
+    """A raising extraction worker fails the whole engine promptly with
+    the original error — never a deadlock (ISSUE satellite)."""
+    refs, counts, results = _materialised(project)
+
+    def faulting():
+        yield results[0]
+        raise RuntimeError("worker exploded mid-extraction")
+
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="worker exploded"):
+        run_streaming_polish(
+            None, None, project.params, project.cfg,
+            out_path=str(tmp_path / "never.fasta"),
+            batch_size=16, log=lambda *a: None,
+            region_source=SimpleNamespace(
+                refs=refs, region_counts=counts, results=faulting()
+            ),
+        )
+    assert time.monotonic() - t0 < 30.0  # failed fast, no deadlock
+    # no valid-looking truncated FASTA left behind (resume-style
+    # pipelines gate on the output file's existence)
+    assert not (tmp_path / "never.fasta").exists()
+    # no threads left parked: a second engine run on a healthy source
+    # works in the same process
+    polished = run_streaming_polish(
+        None, None, project.params, project.cfg, batch_size=16,
+        log=lambda *a: None,
+        region_source=_source(refs, counts, results),
+    )
+    assert polished == project.staged
+
+
+def test_worker_exception_propagates_under_full_queue(project):
+    """The error must surface even when it fires while the queue is
+    saturated (producer parked on put): the consumer keeps draining, so
+    the error item always lands."""
+    refs, counts, results = _materialised(project)
+
+    def faulting():
+        for r in results[:-1]:
+            yield r
+        raise RuntimeError("late worker death")
+
+    with pytest.raises(RuntimeError, match="late worker death"):
+        run_streaming_polish(
+            None, None, project.params, project.cfg,
+            batch_size=16, queue_regions=1,
+            log=lambda *a: None,
+            region_source=SimpleNamespace(
+                refs=refs, region_counts=counts, results=faulting()
+            ),
+        )
+
+
+def test_ordered_fasta_writer_out_of_order(tmp_path):
+    """Out-of-order completions produce the exact write_fasta layout."""
+    path = str(tmp_path / "w.fasta")
+    seqs = {"a": "ACGT" * 50, "m": "", "z": "TTTT" * 21}
+    with _OrderedFastaWriter(path, sorted(seqs)) as w:
+        w.add("z", seqs["z"])
+        w.add("m", seqs["m"])
+        # nothing written yet: "a" gates the order
+        assert open(path).read() == ""
+        w.add("a", seqs["a"])
+    ref = str(tmp_path / "ref.fasta")
+    write_fasta(ref, sorted(seqs.items()))
+    assert open(path, "rb").read() == open(ref, "rb").read()
+    assert [n for n, _ in read_fasta(path)] == ["a", "m", "z"]
+
+
+def test_pipeline_config_cli_layering():
+    """--prefetch / --queue-regions / --batch-delay-ms flow through the
+    layered config; --t no longer sets the loader depth (ISSUE
+    satellite: the overloaded --t split)."""
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args([
+        "polish", "r.fa", "x.bam", "m", "o.fa",
+        "--t", "7", "--prefetch", "5", "--queue-regions", "3",
+        "--batch-delay-ms", "80",
+    ])
+    cfg = _build_config(args)
+    assert cfg.pipeline.prefetch == 5
+    assert cfg.pipeline.queue_regions == 3
+    assert cfg.pipeline.max_batch_delay_ms == 80.0
+    assert args.t == 7  # workers only — not coupled to prefetch
+    # defaults survive when flags are absent
+    args = build_parser().parse_args(["polish", "r.fa", "x.bam", "m", "o.fa"])
+    cfg = _build_config(args)
+    assert cfg.pipeline == PipelineConfig()
+    # inference grew the same split
+    args = build_parser().parse_args(
+        ["inference", "d.h5", "m", "o.fa", "--prefetch", "4"]
+    )
+    assert _build_config(args).pipeline.prefetch == 4
+
+
+def test_pipeline_config_json_round_trip():
+    cfg = RokoConfig(pipeline=PipelineConfig(
+        queue_regions=5, max_batch_delay_ms=33.0, prefetch=9,
+    ))
+    assert RokoConfig.from_json(cfg.to_json()).pipeline == cfg.pipeline
+
+
+@pytest.mark.slow
+def test_run_pipeline_suite_smoke():
+    """The bench pipeline suite produces its contract fields and the
+    two paths agree (slow: two flagship-model compiles)."""
+    from roko_tpu.benchmark import run_pipeline_suite
+
+    out = run_pipeline_suite(draft_len=12_000, coverage=10)
+    assert out["outputs_identical"] is True
+    assert out["overlap_efficiency"] > 0
+    assert out["staged"]["serial_sum_s"] > 0
+    assert "extract" in out["streaming"]["stage_spans_s"]
