@@ -624,94 +624,16 @@ def _emit(result: Dict[str, Any], out_path) -> None:
     print(json.dumps(result))
 
 
-def _wait_no_kill(proc, budget_s: float):
-    """Wait up to ``budget_s`` for ``proc``; return its rc, or None on
-    timeout. NEVER kills: a TPU client killed mid-claim/compile wedges
-    the loopback relay for the rest of the session (observed rounds 2
-    and 3) — on timeout the child is abandoned to finish on its own."""
-    deadline = time.monotonic() + budget_s
-    while time.monotonic() < deadline:
-        rc = proc.poll()
-        if rc is not None:
-            return rc
-        time.sleep(2.0)
-    # final poll: the child may have finished during the last sleep —
-    # misclassifying that as a hang would discard a completed TPU run
-    return proc.poll()
-
-
-def _tail(path, n: int = 2000) -> str:
-    try:
-        with open(path, "r", errors="replace") as f:
-            return f.read()[-n:]
-    except OSError:
-        return ""
-
-
-def _spawn_logged(cmd, budget_s: float, **popen_kw):
-    """Popen ``cmd`` with stdout+stderr to a temp log, wait (never kill)
-    up to ``budget_s``. Returns (rc_or_None, log_tail). The log file is
-    removed unless the child was abandoned (its tail may still be
-    wanted for post-mortem while it runs)."""
-    import os
-    import subprocess
-    import tempfile
-
-    with tempfile.NamedTemporaryFile(
-        "w+", suffix=".log", delete=False
-    ) as logf:
-        proc = subprocess.Popen(
-            cmd, stdout=logf, stderr=subprocess.STDOUT, **popen_kw
-        )
-        rc = _wait_no_kill(proc, budget_s)
-        out = _tail(logf.name)
-    if rc is not None:
-        try:
-            os.unlink(logf.name)
-        except OSError:
-            pass
-    return rc, out
-
-
-def _probe_backend(timeout_s: float, log) -> tuple:
-    """Can a fresh process initialize the JAX backend AND compile?  Runs
-    in a subprocess so a wedged TPU relay hangs the probe, not the
-    artifact path. The tiny jit canary matters: r5 observed a failure
-    mode where ``jax.devices()`` answers but the first XLA compile
-    blocks forever (far side of the relay dead mid-session) — a
-    devices-only probe waves the bench child into that tar pit and the
-    whole TPU budget burns with zero rows measured. A canary hang
-    instead surfaces here as DEVICES_OK-without-PROBE_OK inside
-    ``timeout_s``, and the artifact falls back to CPU with that
-    diagnostic in ``tpu_error``. Returns ``(ok, reason, platform)`` —
-    ``platform`` is the backend the probe actually saw (``"tpu"``,
-    ``"cpu"``, ...) or None when the probe failed before reporting
-    one."""
-    import sys
-
-    code = (
-        "import jax\n"
-        "import jax.numpy as jnp\n"
-        "d = jax.devices()\n"
-        "print('DEVICES_OK', d[0].platform, flush=True)\n"
-        "x = jnp.ones((128, 128), jnp.bfloat16)\n"
-        "y = jax.jit(lambda a, b: (a @ b).sum())(x, x)\n"
-        "assert float(y) != 0.0\n"
-        "print('PROBE_OK', d[0].platform, getattr(d[0], 'device_kind', '?'),"
-        " flush=True)\n"
-    )
-    rc, out = _spawn_logged([sys.executable, "-c", code], timeout_s)
-    if rc is None:
-        return False, (
-            f"backend probe still hung after {timeout_s:.0f}s "
-            f"(relay wedged?); probe abandoned, not killed. tail: {out[-300:]}"
-        ), None
-    if rc != 0 or "PROBE_OK" not in out:
-        return False, f"backend probe rc={rc}: {out[-400:]}", None
-    ok_line = [l for l in out.strip().splitlines() if "PROBE_OK" in l][-1]
-    platform = ok_line.split()[1] if len(ok_line.split()) > 1 else "unknown"
-    log(f"[bench] backend probe ok: {ok_line}")
-    return True, "", platform
+# The probe/abandon machinery lives in roko_tpu.resilience.probe now
+# (shared with tools/chip_probe.py — ONE deadline implementation); the
+# private aliases stay so the orchestration below and the contract
+# tests keep their names.
+from roko_tpu.resilience.probe import (  # noqa: E402
+    probe_backend as _probe_backend,
+    spawn_logged as _spawn_logged,
+    tail_file as _tail,
+    wait_no_kill as _wait_no_kill,
+)
 
 
 def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):

@@ -45,6 +45,38 @@ def _model_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--mlp-ratio", type=int, default=None)
 
 
+def _resilience_args(p: argparse.ArgumentParser, serve: bool = False) -> None:
+    p.add_argument(
+        "--predict-deadline", type=float, default=None,
+        help="watchdog: seconds one device compile/predict call may take "
+        "before the run dumps thread stacks and aborts (or falls over, "
+        "see --hang-fallback); 0 disables (default 600)",
+    )
+    p.add_argument(
+        "--hang-fallback", choices=("none", "cpu"), default=None,
+        help="on a blown predict deadline: 'none' exits nonzero with the "
+        "hang diagnostic, 'cpu' finishes the run on a host-CPU predict "
+        "step (degraded throughput, completed output)",
+    )
+    if serve:
+        p.add_argument(
+            "--breaker-failures", type=int, default=None,
+            help="circuit breaker: consecutive device failures that trip "
+            "it (healthz 503 + /polish load shedding; default 5, "
+            "0 disables)",
+        )
+        p.add_argument(
+            "--breaker-reset-s", type=float, default=None,
+            help="circuit breaker: seconds an open breaker waits before "
+            "half-open probing (default 30)",
+        )
+        p.add_argument(
+            "--drain-deadline", type=float, default=None,
+            help="SIGTERM drain: seconds in-flight requests get to finish "
+            "before the process exits anyway (default 20)",
+        )
+
+
 def _window_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--window-rows", type=int, default=None, help="pileup rows per window")
     p.add_argument("--window-cols", type=int, default=None, help="pileup columns per window")
@@ -123,10 +155,16 @@ def _build_config(args: argparse.Namespace):
         prefetch="prefetch", queue_regions="queue_regions",
         max_batch_delay_ms="batch_delay_ms",
     )
+    resilience = over(
+        base.resilience,
+        predict_deadline_s="predict_deadline", hang_fallback="hang_fallback",
+        breaker_failures="breaker_failures", breaker_reset_s="breaker_reset_s",
+        drain_deadline_s="drain_deadline",
+    )
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, mesh=mesh, serve=serve,
-        pipeline=pipeline,
+        pipeline=pipeline, resilience=resilience,
     )
 
 
@@ -291,8 +329,15 @@ def cmd_polish(args: argparse.Namespace) -> int:
             trace_dir=args.trace_dir,
             job_retries=args.job_retries,
             job_timeout=args.job_timeout,
+            resume=args.resume,
         )
         print(f"wrote polished contigs to {args.out}")
+    elif args.resume:
+        raise SystemExit(
+            "polish --resume is a streaming-engine feature (the journal "
+            "rides the incremental writer); it cannot combine with "
+            "--staged or a multi-host pod."
+        )
     else:
         from roko_tpu.features.pipeline import run_features
         from roko_tpu.infer import polish_to_fasta
@@ -593,6 +638,12 @@ def build_parser() -> argparse.ArgumentParser:
         "of the default streaming engine (docs/PIPELINE.md)",
     )
     p.add_argument(
+        "--resume", action="store_true",
+        help="resume a crashed run from the sidecar journal next to the "
+        "output (<out>.resume/): committed contigs are not re-extracted; "
+        "the final FASTA is byte-identical to an uninterrupted run",
+    )
+    p.add_argument(
         "--queue-regions", type=int, default=None,
         help="streaming: bounded region-queue depth in region blocks "
         "(default 8; full queue blocks extraction workers)",
@@ -618,6 +669,7 @@ def build_parser() -> argparse.ArgumentParser:
     _model_args(p)
     _mesh_args(p)
     _window_args(p)
+    _resilience_args(p)
     p.set_defaults(fn=cmd_polish)
 
     p = sub.add_parser(
@@ -646,6 +698,7 @@ def build_parser() -> argparse.ArgumentParser:
     _model_args(p)
     _mesh_args(p)
     _window_args(p)
+    _resilience_args(p, serve=True)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

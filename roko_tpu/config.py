@@ -189,6 +189,32 @@ class PipelineConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Failure-handling knobs shared by pipeline, serve, and bench
+    (roko_tpu/resilience; docs/PIPELINE.md + docs/SERVING.md
+    "Failure handling")."""
+
+    #: hard deadline on one device compile/predict call — on expiry the
+    #: watchdog dumps every thread stack and raises instead of hanging
+    #: forever (the r5 wedge signature: devices answer, the first XLA
+    #: compile never returns). 0 disables the watchdog entirely.
+    predict_deadline_s: float = 600.0
+    #: what a blown predict deadline does next: "none" propagates the
+    #: HangError (the CLI exits nonzero), "cpu" recompiles the predict
+    #: step on the host CPU and finishes the run there — degraded
+    #: throughput, completed output
+    hang_fallback: str = "none"
+    #: serve: consecutive device failures that trip the circuit breaker
+    #: (healthz goes unhealthy, /polish sheds load with 503+Retry-After)
+    breaker_failures: int = 5
+    #: serve: seconds an open breaker waits before half-open probing
+    breaker_reset_s: float = 30.0
+    #: serve: SIGTERM drain deadline — seconds in-flight requests get
+    #: to finish before the process exits anyway
+    drain_deadline_s: float = 20.0
+
+
+@dataclass(frozen=True)
 class RokoConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
@@ -198,6 +224,7 @@ class RokoConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -216,6 +243,7 @@ class RokoConfig:
             serve=ServeConfig(**{k: tuple(v) if k == "ladder" else v
                                  for k, v in raw.get("serve", {}).items()}),
             pipeline=PipelineConfig(**raw.get("pipeline", {})),
+            resilience=ResilienceConfig(**raw.get("resilience", {})),
         )
 
 

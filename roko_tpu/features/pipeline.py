@@ -318,18 +318,33 @@ def _recovering_results(results, func, jobs, retries, timeout, log, pool=None):
     """
     import multiprocessing as mp
 
+    from roko_tpu.resilience import RetryPolicy
+
+    # region jobs are pure and cheap to re-dispatch, so the shared
+    # policy runs with zero backoff: the retry IS the recovery, there
+    # is no remote rate limit to be polite to
+    policy = RetryPolicy(
+        max_attempts=max(1, retries), base_delay_s=0.0, jitter=0.0,
+        retryable=(Exception,),
+    )
+
     def rerun(job, err):
-        for attempt in range(retries):
+        def describe(e):
             log(
                 f"features: region {job.region.name}:{job.region.start} "
-                f"failed ({type(err).__name__}: {err}); "
-                f"retry {attempt + 1}/{retries} in the parent"
+                f"failed ({type(e).__name__}: {e}); "
+                f"retry {describe.attempt}/{retries} in the parent"
             )
-            try:
-                return func(job)
-            except Exception as e2:  # noqa: PERF203 - retry loop
-                err = e2
-        raise err
+            describe.attempt += 1
+
+        describe.attempt = 1
+        if retries <= 0:
+            raise err
+        describe(err)  # the pool-side failure that brought us here
+        return policy.call(
+            lambda: func(job),
+            on_retry=lambda failures, e, delay: describe(e),
+        )
 
     it = iter(results)
     can_timeout = (
@@ -386,8 +401,14 @@ def open_region_stream(
     log=print,
     job_retries: int = 1,
     job_timeout: Optional[float] = None,
+    skip_contigs: Optional[set] = None,
 ) -> Iterator[RegionStream]:
     """Open the region fan-out and yield a :class:`RegionStream`.
+
+    ``skip_contigs`` names contigs to generate NO region jobs for (the
+    crash-resume path: contigs already committed in a polish journal
+    must not be re-extracted); they stay in ``refs`` so consumers keep
+    the full draft picture.
 
     Owns the whole extraction lifecycle: SAM->BAM conversion temp files,
     pool creation, the failure-recovery wrapper, and pool teardown on
@@ -405,6 +426,8 @@ def open_region_stream(
 
         jobs: List[_Job] = []
         for name, seq in refs:
+            if skip_contigs and name in skip_contigs:
+                continue
             for region in generate_regions(len(seq), name, config.region):
                 jobs.append(
                     _Job(

@@ -105,6 +105,29 @@ def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
     return step
 
 
+def make_cpu_predict(model: RokoModel, params_host: Params) -> Callable:
+    """Host-CPU predict closure for watchdog fail-over
+    (roko_tpu/resilience): same forward + argmax as
+    :func:`make_predict_step` but compiled for the CPU backend on a
+    single device — usable while the accelerator is presumed wedged.
+    Inputs are still padded to the ladder by the caller, so the CPU
+    compile set stays as bounded as the device one. Throughput is
+    degraded by orders of magnitude; the point is a COMPLETED run with
+    correct output, not a fast one."""
+    cpu = jax.local_devices(backend="cpu")[0]
+
+    @jax.jit
+    def step(params, x):
+        logits = model.apply(params, x, deterministic=True)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def predict(x: np.ndarray) -> np.ndarray:
+        with jax.default_device(cpu):
+            return np.asarray(step(params_host, x))
+
+    return predict
+
+
 class VoteBoard:
     """Per-contig vote accumulator.
 

@@ -60,11 +60,14 @@ from roko_tpu.features.pipeline import open_region_stream
 from roko_tpu.io.fasta import write_fasta_record
 from roko_tpu.infer import (
     VoteBoard,
+    make_cpu_predict,
     make_predict_step,
     pad_windows,
     rung_for,
     tail_rungs,
 )
+from roko_tpu.resilience import HangError, PolishJournal, call_with_deadline
+from roko_tpu.resilience.watchdog import thread_stack
 from roko_tpu.models.model import RokoModel
 from roko_tpu.parallel.mesh import (
     AXIS_DP,
@@ -131,7 +134,12 @@ class _RegionProducer:
     producer can emit a ``("done", contig, total_windows)`` notice the
     moment a contig's LAST region block has been queued — whatever
     order regions complete in. The consumer stitches on that notice as
-    soon as the windows it promises have been voted."""
+    soon as the windows it promises have been voted.
+
+    ``skip`` names contigs whose blocks and done-notices are dropped at
+    this boundary — the resume path: a journal-committed contig needs
+    no votes, and dropping here covers injected region sources that
+    were not pre-filtered the way ``open_region_stream`` is."""
 
     def __init__(
         self,
@@ -140,12 +148,14 @@ class _RegionProducer:
         timer: StageTimer,
         tee: Optional[DataWriter] = None,
         flush_every: int = 10,
+        skip: Optional[set] = None,
     ):
         self.source = source
         self.q = q
         self.timer = timer
         self.tee = tee
         self.flush_every = flush_every
+        self.skip = skip or set()
         self.stop = threading.Event()
         self.thread = threading.Thread(
             target=self._run, name="roko-stream-extract", daemon=True
@@ -184,6 +194,8 @@ class _RegionProducer:
                 if self.stop.is_set():
                     return
                 contig, pos, x, _ = result
+                if contig in self.skip:
+                    continue
                 if self.tee is not None:
                     with self.timer("tee_hdf5"):
                         self.tee.store(contig, pos, x, None)
@@ -308,6 +320,31 @@ def _device_batches(
             return
 
 
+def _journal_identity(cfg: RokoConfig, params) -> Dict[str, Any]:
+    """Everything, besides ref/bam/seed, that the polished bytes depend
+    on: the model weights and the window/extraction geometry. A resume
+    against a journal whose identity differs would silently splice two
+    different polishes into one FASTA, so the journal refuses it
+    (:class:`JournalMismatch`)."""
+    import dataclasses
+    import hashlib
+
+    h = hashlib.sha1()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return {
+        "params_sha1": h.hexdigest(),
+        "config": {
+            name: dataclasses.asdict(getattr(cfg, name))
+            for name in ("window", "read_filter", "region", "model")
+        },
+    }
+
+
 def run_streaming_polish(
     ref_path: Optional[str],
     bam_x: Optional[str],
@@ -330,6 +367,7 @@ def run_streaming_polish(
     job_retries: int = 1,
     job_timeout: Optional[float] = None,
     region_source=None,
+    resume: bool = False,
 ) -> Dict[str, str]:
     """Polish ``ref_path``+``bam_x`` to ``{contig: sequence}`` with
     feature extraction, host batching, and device inference overlapped;
@@ -341,7 +379,19 @@ def run_streaming_polish(
     ``region_source`` overrides the extraction fan-out with any object
     exposing ``refs``, ``region_counts`` and ``results`` (tests inject
     out-of-order and faulting sources through it). Single-host only:
-    pods keep the staged contig-sharded path (``polish_to_fasta``)."""
+    pods keep the staged contig-sharded path (``polish_to_fasta``).
+
+    Resilience (roko_tpu/resilience; docs/PIPELINE.md "Failure
+    handling"): when ``out_path`` is given every finished contig is
+    durably committed to a sidecar journal (``<out>.resume/``) before
+    it reaches the FASTA; ``resume=True`` reloads a matching journal,
+    skips extraction for committed contigs, and the final FASTA is
+    byte-identical to an uninterrupted run. Device compile/predict
+    calls run under ``cfg.resilience.predict_deadline_s`` — on a hang
+    the watchdog dumps thread stacks and either raises
+    :class:`HangError` (nonzero exit) or, with
+    ``cfg.resilience.hang_fallback == "cpu"``, finishes the run on a
+    host-CPU predict step."""
     if jax.process_count() > 1:
         raise RuntimeError(
             "streaming polish is single-host; run the staged features + "
@@ -362,6 +412,7 @@ def run_streaming_polish(
         raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
 
     model = RokoModel(cfg.model)
+    params_host = params  # kept host-side for the CPU hang fail-over
     params = jax.device_put(params, replicated_sharding(mesh))
     predict = make_predict_step(model, mesh)
     sharding = data_sharding(mesh)
@@ -369,13 +420,38 @@ def run_streaming_polish(
     # deadline flushes never hand the compiler a novel shape
     rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
     timer = timer if timer is not None else StageTimer()
+    rcfg = cfg.resilience
+
+    if resume and not out_path:
+        raise ValueError(
+            "resume needs an output path: the journal lives beside it"
+        )
+    if resume and tee_hdf5:
+        raise ValueError(
+            "resume cannot tee a features HDF5: committed contigs are not "
+            "re-extracted, so the tee would be missing their windows"
+        )
+    journal: Optional[PolishJournal] = None
+    committed: Dict[str, Tuple[str, int]] = {}
+    if out_path:
+        journal = PolishJournal(out_path)
+        committed = journal.open(
+            dict(
+                {"ref": str(ref_path), "bam": str(bam_x), "seed": seed},
+                **_journal_identity(cfg, params_host),
+            ),
+            resume=resume,
+            log=log,
+        )
 
     with contextlib.ExitStack() as stack:
+        stack.callback(lambda: journal and journal.close())
         if region_source is None:
             region_source = stack.enter_context(
                 open_region_stream(
                     ref_path, bam_x, workers=workers, seed=seed, config=cfg,
                     log=log, job_retries=job_retries, job_timeout=job_timeout,
+                    skip_contigs=set(committed) or None,
                 )
             )
         contigs = {name: seq for name, seq in region_source.refs}
@@ -394,7 +470,9 @@ def run_streaming_polish(
 
         q: "queue.Queue" = queue.Queue(maxsize=max(1, queue_regions))
         stop = threading.Event()
-        producer = _RegionProducer(region_source, q, timer)
+        producer = _RegionProducer(
+            region_source, q, timer, skip=set(committed)
+        )
         # the tee is NOT ExitStack-managed: only the producer thread
         # touches the h5py handle once that thread starts, so it must
         # be closed only after the thread is confirmed dead (closing an
@@ -414,14 +492,24 @@ def run_streaming_polish(
         # contig -> final window count, known once its last region has
         # been extracted ("done" notices); zero-region contigs (shorter
         # than any region, impossible today, or zero-length) are final
-        # from the start and stitch to the unchanged draft immediately
+        # from the start and stitch to the unchanged draft immediately.
+        # Journal-committed contigs are done before the run starts:
+        # their sequences come from the journal, not the board.
         final_counts: Dict[str, int] = {
             name: 0
             for name in contigs
-            if region_source.region_counts.get(name, 0) == 0
+            if name not in committed
+            and region_source.region_counts.get(name, 0) == 0
         }
-        voted: Dict[str, int] = {name: 0 for name in contigs}
-        polished: Dict[str, str] = {}
+        voted: Dict[str, int] = {
+            name: 0 for name in contigs if name not in committed
+        }
+        polished: Dict[str, str] = {
+            name: seq for name, (seq, _) in committed.items()
+        }
+        if writer is not None:
+            for name in sorted(committed):
+                writer.add(name, polished[name])
 
         def finish_ready() -> None:
             # final_counts only holds extraction-complete, not-yet-
@@ -437,24 +525,88 @@ def run_streaming_polish(
                 with timer("stitch"):
                     seq = board.stitch(name)
                 polished[name] = seq
+                if journal is not None:
+                    # durable commit BEFORE the (non-atomic) FASTA
+                    # append: the journal, not the FASTA, is what a
+                    # crashed run resumes from
+                    with timer("journal"):
+                        journal.commit(name, seq, voted[name])
+                    log(
+                        f"polish: committed contig {name} "
+                        f"({voted[name]} windows)"
+                    )
                 if writer is not None:
                     with timer("write_fasta"):
                         writer.add(name, seq)
 
+        # Device watchdog (roko_tpu/resilience): every compile/predict
+        # interaction runs under cfg.resilience.predict_deadline_s. On a
+        # hang (the r5 wedge: devices answer, the first XLA compile
+        # never returns) the watchdog dumps thread stacks, emits the
+        # ROKO_WATCHDOG line, and either the HangError propagates to a
+        # nonzero exit or — hang_fallback == "cpu" — the run finishes on
+        # a host-CPU predict step. The padded host batch rides along in
+        # every entry so a fallback can recompute it without touching
+        # the wedged device.
+        cpu_predict: List = [None]  # one-slot box (set-once after a hang)
+
+        def fail_over(stage: str):
+            if rcfg.hang_fallback != "cpu":
+                raise  # re-raise the active HangError
+            if cpu_predict[0] is None:
+                log(
+                    f"watchdog: device hung in {stage}; failing over to "
+                    "the host CPU predict step (degraded throughput, "
+                    "completed output)"
+                )
+                cpu_predict[0] = make_cpu_predict(model, params_host)
+            return cpu_predict[0]
+
         def place(item):
             names, pos, x, n, comps = item
             if n == 0:
-                return names, pos, None, 0, comps
+                return names, pos, None, None, 0, comps
             x = pad_windows(x, rung_for(rungs, n))
+            if cpu_predict[0] is not None:
+                # device presumed wedged: stop shipping batches to it
+                return names, pos, None, x, n, comps
             # device_put dispatches asynchronously; transfer cost shows
             # up inside "predict+d2h" (same attribution as run_inference)
-            return names, pos, jax.device_put(x, sharding), n, comps
+            return names, pos, jax.device_put(x, sharding), x, n, comps
+
+        def dispatch(dev, x_padded):
+            """Start one batch's predict: a device future in the happy
+            path, host preds when failed over to CPU."""
+            if cpu_predict[0] is not None or dev is None:
+                fn = cpu_predict[0] or fail_over("predict-dispatch")
+                return "preds", fn(x_padded)
+            try:
+                fut = call_with_deadline(
+                    lambda: predict(params, dev),
+                    rcfg.predict_deadline_s,
+                    stage="pipeline-predict-dispatch",
+                    log=log,
+                )
+                return "fut", fut
+            except HangError:
+                return "preds", fail_over("predict-dispatch")(x_padded)
 
         def drain(entry) -> int:
-            names, pos, fut, n, comps = entry
+            names, pos, kind, val, x_padded, n, comps = entry
             if n:
                 with timer("predict+d2h"):
-                    preds = np.asarray(jax.device_get(fut))[:n]
+                    if kind == "fut":
+                        try:
+                            preds = call_with_deadline(
+                                lambda: np.asarray(jax.device_get(val)),
+                                rcfg.predict_deadline_s,
+                                stage="pipeline-predict-fetch",
+                                log=log,
+                            )[:n]
+                        except HangError:
+                            preds = fail_over("predict-fetch")(x_padded)[:n]
+                    else:
+                        preds = val[:n]
                 with timer("vote"):
                     board.add(names, pos, preds)
                 for name, cnt in Counter(names).items():
@@ -479,11 +631,11 @@ def run_streaming_polish(
                     prefetch,
                     place,
                 ):
-                    names, pos, dev, n, comps = item
-                    fut = predict(params, dev) if n else None
+                    names, pos, dev, x_padded, n, comps = item
+                    kind, val = dispatch(dev, x_padded) if n else (None, None)
                     if pending is not None:
                         n_windows += drain(pending)
-                    pending = (names, pos, fut, n, comps)
+                    pending = (names, pos, kind, val, x_padded, n, comps)
                 if pending is not None:
                     n_windows += drain(pending)
         finally:
@@ -503,6 +655,17 @@ def run_streaming_polish(
                     # cannot (its _put gives up 0.1s after stop) —
                     # wait it out once
                     producer.thread.join(timeout=25.0)
+                if producer.thread.is_alive():
+                    # abandoning a daemon thread silently hides a real
+                    # wedge (and with --keep-hdf5 leaves the tee handle
+                    # open): say LOUDLY what is stuck and where
+                    stack = thread_stack(producer.thread)
+                    log(
+                        "WARNING: abandoning producer thread "
+                        f"{producer.thread.name!r} still running 30s "
+                        "after shutdown; it is stuck at:\n"
+                        + (stack or "<thread exited during the dump>")
+                    )
             if tee is not None:
                 if not producer.thread.is_alive():
                     tee.__exit__(None, None, None)
@@ -517,6 +680,11 @@ def run_streaming_polish(
                 f"streaming polish ended with unfinished contigs: "
                 f"{missing[:5]}{'...' if len(missing) > 5 else ''}"
             )
+    if journal is not None:
+        # the run is whole (writer closed cleanly above): the journal
+        # has nothing left to protect. On ANY failure path we never get
+        # here and the journal survives for --resume.
+        journal.finalize()
     dt = time.perf_counter() - t0
     log(f"extracted {n_windows} windows")
     log(

@@ -1,0 +1,47 @@
+"""Resilient-runtime primitives shared by pipeline, serve, and bench
+(docs/PIPELINE.md + docs/SERVING.md "Failure handling").
+
+Round-5 operations hit a wedge signature the stack could not survive:
+the device answers the init probe, then the first XLA compile hangs
+forever — a silent infinite hang that loses the whole session
+(VERDICT.md). Production JAX/TPU stacks treat hang detection,
+preemption-resume, and bounded retries as first-class infrastructure
+(t5x arxiv 2203.17189; TPUv4 pjit training arxiv 2204.06514); this
+package is that layer for roko:
+
+- ``watchdog``  — hard deadlines around calls that can hang forever
+  (device compile/predict): thread-stack dump + one-line parseable
+  diagnostic + :class:`HangError`, never a silent hang;
+- ``retry``     — one :class:`RetryPolicy` (attempts, exponential
+  backoff + jitter, retryable classes, Retry-After floors) behind the
+  features fan-out re-runs, the HTTP client, and anything else that
+  re-executes pure work;
+- ``breaker``   — :class:`CircuitBreaker` for the serve layer: trips
+  after N consecutive device failures, half-open probing re-closes it;
+- ``journal``   — :class:`PolishJournal`, the sidecar manifest that
+  makes the streaming polish crash-resumable (``roko-tpu polish
+  --resume``);
+- ``probe``     — the subprocess jit-canary backend probe (the bench's
+  former bespoke implementation, shared with ``tools/chip_probe.py``).
+"""
+
+from roko_tpu.resilience.breaker import CircuitBreaker
+from roko_tpu.resilience.journal import JournalMismatch, PolishJournal
+from roko_tpu.resilience.probe import probe_backend
+from roko_tpu.resilience.retry import RetryPolicy
+from roko_tpu.resilience.watchdog import (
+    HangError,
+    call_with_deadline,
+    dump_thread_stacks,
+)
+
+__all__ = [
+    "CircuitBreaker",
+    "HangError",
+    "JournalMismatch",
+    "PolishJournal",
+    "RetryPolicy",
+    "call_with_deadline",
+    "dump_thread_stacks",
+    "probe_backend",
+]

@@ -1,0 +1,107 @@
+"""Subprocess backend probe: device init + a tiny jit canary under a
+hard deadline — the out-of-process face of the watchdog.
+
+The in-process watchdog (``resilience/watchdog.py``) protects calls in
+a process whose backend is already live. This module answers the prior
+question — *is the backend safe to initialize at all?* — by paying the
+init + first-compile cost in a child process. The canary matters: r5
+observed ``jax.devices()`` answering while the first XLA compile blocks
+forever; a devices-only probe waves callers into that tar pit. The
+child is never killed on timeout, only abandoned: killing a TPU client
+mid-claim/compile wedges the loopback relay for the rest of the session
+(observed rounds 2 and 3).
+
+Users: ``roko_tpu/benchmark.py`` (probe-then-measure orchestration) and
+``tools/chip_probe.py`` (the one-line CHIP_OK/CHIP_DOWN health check) —
+one deadline implementation, not two.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Optional, Tuple
+
+
+def wait_no_kill(proc, budget_s: float) -> Optional[int]:
+    """Wait up to ``budget_s`` for ``proc``; return its rc, or None on
+    timeout. NEVER kills: on timeout the child is abandoned to finish
+    on its own (see module docstring)."""
+    deadline = time.monotonic() + budget_s
+    while time.monotonic() < deadline:
+        rc = proc.poll()
+        if rc is not None:
+            return rc
+        time.sleep(2.0)
+    # final poll: the child may have finished during the last sleep —
+    # misclassifying that as a hang would discard a completed run
+    return proc.poll()
+
+
+def tail_file(path: str, n: int = 2000) -> str:
+    try:
+        with open(path, "r", errors="replace") as f:
+            return f.read()[-n:]
+    except OSError:
+        return ""
+
+
+def spawn_logged(cmd, budget_s: float, **popen_kw) -> Tuple[Optional[int], str]:
+    """Popen ``cmd`` with stdout+stderr to a temp log, wait (never kill)
+    up to ``budget_s``. Returns (rc_or_None, log_tail). The log file is
+    removed unless the child was abandoned (its tail may still be
+    wanted for post-mortem while it runs)."""
+    with tempfile.NamedTemporaryFile(
+        "w+", suffix=".log", delete=False
+    ) as logf:
+        proc = subprocess.Popen(
+            cmd, stdout=logf, stderr=subprocess.STDOUT, **popen_kw
+        )
+        rc = wait_no_kill(proc, budget_s)
+        out = tail_file(logf.name)
+    if rc is not None:
+        try:
+            os.unlink(logf.name)
+        except OSError:
+            pass
+    return rc, out
+
+
+_CANARY = (
+    "import jax\n"
+    "import jax.numpy as jnp\n"
+    "d = jax.devices()\n"
+    "print('DEVICES_OK', d[0].platform, flush=True)\n"
+    "x = jnp.ones((128, 128), jnp.bfloat16)\n"
+    "y = jax.jit(lambda a, b: (a @ b).sum())(x, x)\n"
+    "assert float(y) != 0.0\n"
+    "print('PROBE_OK', d[0].platform, getattr(d[0], 'device_kind', '?'),"
+    " flush=True)\n"
+)
+
+
+def probe_backend(timeout_s: float, log) -> Tuple[bool, str, Optional[str]]:
+    """Can a fresh process initialize the JAX backend AND compile?
+
+    Runs in a subprocess so a wedged relay hangs the probe child, not
+    the caller. A canary hang surfaces as DEVICES_OK-without-PROBE_OK
+    inside ``timeout_s`` and callers fall back (bench: to CPU, with the
+    diagnostic in ``tpu_error``). Returns ``(ok, reason, platform)`` —
+    ``platform`` is the backend the probe actually saw (``"tpu"``,
+    ``"cpu"``, ...) or None when the probe failed before reporting
+    one."""
+    rc, out = spawn_logged([sys.executable, "-c", _CANARY], timeout_s)
+    if rc is None:
+        return False, (
+            f"backend probe still hung after {timeout_s:.0f}s "
+            f"(relay wedged?); probe abandoned, not killed. tail: {out[-300:]}"
+        ), None
+    if rc != 0 or "PROBE_OK" not in out:
+        return False, f"backend probe rc={rc}: {out[-400:]}", None
+    ok_line = [l for l in out.strip().splitlines() if "PROBE_OK" in l][-1]
+    platform = ok_line.split()[1] if len(ok_line.split()) > 1 else "unknown"
+    log(f"[bench] backend probe ok: {ok_line}")
+    return True, "", platform

@@ -1,0 +1,126 @@
+"""Device watchdog: hard deadlines around calls that can hang forever.
+
+The r5 wedge signature (VERDICT.md): the device answers ``jax.devices()``
+but the first XLA compile never returns. In-process there is no way to
+interrupt that call — Python cannot kill a thread stuck in native code —
+so the only survivable shape is to make the *caller* expendable: run the
+hazardous call on a sacrificial daemon thread, wait with a deadline, and
+when it expires dump every thread's stack, emit one machine-parseable
+diagnostic line, and raise :class:`HangError` from the (still healthy)
+watching thread. The stuck thread is abandoned; being a daemon it cannot
+block interpreter exit. Callers then either fail over (the pipeline and
+serve session fall back to a CPU predict when configured) or let the
+error propagate to a loud nonzero exit — never a silent infinite hang.
+
+The diagnostic line is grep-stable::
+
+    ROKO_WATCHDOG hang stage=<name> deadline_s=<d> threads=<n>
+
+followed by the full ``sys._current_frames`` stack dump, so a wedged
+production run leaves enough post-mortem in its log to see exactly which
+frame never returned.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import traceback
+from typing import Any, Callable, Optional
+
+Log = Callable[[str], None]
+
+
+class HangError(RuntimeError):
+    """A watched call blew its deadline. The offending call is still
+    running on an abandoned daemon thread; the device behind it must be
+    presumed wedged."""
+
+    def __init__(self, stage: str, deadline_s: float):
+        super().__init__(
+            f"{stage!r} still running after its {deadline_s:g}s deadline; "
+            "device presumed hung (thread stacks dumped to the log)"
+        )
+        self.stage = stage
+        self.deadline_s = deadline_s
+
+
+def dump_thread_stacks(skip_current: bool = False) -> str:
+    """Every live thread's stack, rendered for the log — the post-mortem
+    payload behind the one-line diagnostic (``sys._current_frames`` is
+    the same source ``faulthandler`` reads, but this string can go
+    through a ``log`` callable instead of straight to a real fd)."""
+    names = {t.ident: t for t in threading.enumerate()}
+    current = threading.get_ident()
+    chunks = []
+    for ident, frame in sorted(sys._current_frames().items()):
+        if skip_current and ident == current:
+            continue
+        t = names.get(ident)
+        label = t.name if t is not None else "?"
+        daemon = " daemon" if t is not None and t.daemon else ""
+        chunks.append(
+            f"--- thread {label} (ident={ident}{daemon}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    return "".join(chunks).rstrip()
+
+
+def thread_stack(thread: threading.Thread) -> str:
+    """One live thread's current stack (empty string when the thread is
+    gone) — for "I am abandoning this stuck thread" log warnings."""
+    frame = sys._current_frames().get(thread.ident)
+    if frame is None:
+        return ""
+    return "".join(traceback.format_stack(frame)).rstrip()
+
+
+def hang_diagnostic(stage: str, deadline_s: float) -> str:
+    """The one-line machine-parseable hang record (ROKO_WATCHDOG ...)."""
+    return (
+        f"ROKO_WATCHDOG hang stage={stage} deadline_s={deadline_s:g} "
+        f"threads={threading.active_count()}"
+    )
+
+
+def call_with_deadline(
+    fn: Callable[[], Any],
+    deadline_s: float,
+    *,
+    stage: str = "call",
+    log: Optional[Log] = None,
+) -> Any:
+    """Run ``fn()`` under a hard deadline.
+
+    ``deadline_s <= 0`` disables the watchdog (``fn`` runs inline on the
+    calling thread — zero overhead, zero protection). Otherwise ``fn``
+    runs on a sacrificial daemon thread; on expiry the diagnostic line
+    plus all thread stacks go to ``log`` and :class:`HangError` raises
+    in the caller. An exception raised by ``fn`` itself re-raises here
+    unchanged (with its original traceback attached).
+    """
+    if deadline_s <= 0:
+        return fn()
+    log = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    box: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(
+        target=run, name=f"roko-watchdog-{stage}", daemon=True
+    )
+    t.start()
+    if not done.wait(deadline_s):
+        log(hang_diagnostic(stage, deadline_s))
+        log(dump_thread_stacks(skip_current=True))
+        raise HangError(stage, deadline_s)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]

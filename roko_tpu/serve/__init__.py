@@ -24,7 +24,7 @@ Modules:
 from roko_tpu.serve.batcher import Backpressure, MicroBatcher
 from roko_tpu.serve.client import PolishClient, ServerBusy
 from roko_tpu.serve.metrics import ServeMetrics
-from roko_tpu.serve.server import make_server, serve_forever
+from roko_tpu.serve.server import drain, make_server, serve_forever
 from roko_tpu.serve.session import PolishSession
 
 __all__ = [
@@ -34,6 +34,7 @@ __all__ = [
     "PolishSession",
     "ServeMetrics",
     "ServerBusy",
+    "drain",
     "make_server",
     "serve_forever",
 ]

@@ -26,18 +26,22 @@ from typing import List, Optional
 
 import numpy as np
 
+from roko_tpu.resilience import CircuitBreaker
 from roko_tpu.serve.metrics import ServeMetrics
 from roko_tpu.serve.session import PolishSession
 
+#: exception classes that indict the REQUEST, not the device: they never
+#: move the circuit breaker (a client's bad window geometry says nothing
+#: about chip health)
+_REQUEST_ERRORS = (ValueError, TypeError)
+
 
 class Backpressure(Exception):
-    """Request rejected because the queue is full; retry after
-    ``retry_after_s`` seconds."""
+    """Request rejected without touching the device — queue full or
+    circuit breaker open; retry after ``retry_after_s`` seconds."""
 
-    def __init__(self, retry_after_s: float):
-        super().__init__(
-            f"request queue full; retry after {retry_after_s:.1f}s"
-        )
+    def __init__(self, retry_after_s: float, reason: str = "request queue full"):
+        super().__init__(f"{reason}; retry after {retry_after_s:.1f}s")
         self.retry_after_s = retry_after_s
 
 
@@ -80,10 +84,16 @@ class MicroBatcher:
         max_delay_ms: Optional[float] = None,
         retry_after_s: Optional[float] = None,
         metrics: Optional[ServeMetrics] = None,
+        breaker: Optional[CircuitBreaker] = None,
         start: bool = True,
     ):
         serve_cfg = session.cfg.serve
         self.session = session
+        #: circuit breaker over DEVICE failures (None = disabled): trips
+        #: after N consecutive failed dispatches; while open, submit()
+        #: sheds load instantly with Backpressure instead of feeding a
+        #: sick device whole request timeouts (docs/SERVING.md)
+        self.breaker = breaker
         self.max_delay_s = (
             serve_cfg.max_delay_ms if max_delay_ms is None else max_delay_ms
         ) / 1e3
@@ -145,10 +155,24 @@ class MicroBatcher:
         their futures)."""
         if self._stopped:
             raise RuntimeError("batcher stopped")
+        if self.breaker is not None and not self.breaker.allow():
+            # open (or half-open with the probe slot taken): shed load
+            # without touching the queue; tell the client when the
+            # breaker could next admit it
+            if self.metrics is not None:
+                self.metrics.inc("rejected")
+            raise Backpressure(
+                max(self.breaker.retry_after_s(), self.retry_after_s),
+                reason="circuit breaker open (device failing)",
+            )
         req = _Request(np.ascontiguousarray(x, dtype=np.uint8))
         try:
             self._q.put_nowait(req)
         except queue.Full:
+            if self.breaker is not None:
+                # a half-open allow() claimed the probe slot for a
+                # request that never made it in — release it
+                self.breaker.cancel_probe()
             if self.metrics is not None:
                 self.metrics.inc("rejected")
             raise Backpressure(self.retry_after_s) from None
@@ -226,6 +250,15 @@ class MicroBatcher:
             )
             preds = self.session.predict(x)
         except BaseException as e:  # propagate to every waiter
+            if self.breaker is not None:
+                if isinstance(e, _REQUEST_ERRORS):
+                    # request-shaped failure proves nothing about the
+                    # device; a half-open probe it rode must be released
+                    self.breaker.cancel_probe()
+                else:
+                    # device-shaped failure (HangError, XLA runtime
+                    # error, ...): one step toward tripping the breaker
+                    self.breaker.record_failure()
             for r in batch:
                 r.error = e
                 r.done.set()
@@ -234,6 +267,8 @@ class MicroBatcher:
             # 500 handler) — counting the shared batch failure here too
             # would inflate the series by 1 per coalesced batch
             return
+        if self.breaker is not None:
+            self.breaker.record_success()
         off = 0
         for r, n in zip(batch, sizes):
             r.preds = preds[off : off + n]

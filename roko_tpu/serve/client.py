@@ -2,8 +2,12 @@
 
 Encodes window arrays in the wire format ``server.py`` expects
 (base64 raw little-endian), maps the server's backpressure reply to
-:class:`ServerBusy` with the parsed ``retry_after_s``, and optionally
-retries through it.
+:class:`ServerBusy` with the parsed ``retry_after_s``, and retries
+through busy replies with the shared
+:class:`roko_tpu.resilience.RetryPolicy` — exponential backoff +
+jitter, FLOORED by the server's ``Retry-After`` (the server names the
+minimum wait; the growing backoff and jitter keep a fleet of rejected
+clients from returning in lockstep).
 """
 
 from __future__ import annotations
@@ -16,6 +20,8 @@ import urllib.request
 from typing import Any, Dict, Optional
 
 import numpy as np
+
+from roko_tpu.resilience import RetryPolicy
 
 
 class ServerBusy(RuntimeError):
@@ -34,9 +40,16 @@ def _b64(arr: np.ndarray, dtype) -> str:
 
 
 class PolishClient:
+    #: backoff shape behind ``retries`` (attempt budget layers on top);
+    #: swap the attribute for a custom policy or a no-sleep test double
+    retry_policy = RetryPolicy(
+        base_delay_s=0.5, max_delay_s=30.0, retryable=(ServerBusy,)
+    )
+
     def __init__(self, base_url: str, timeout: float = 120.0):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        self._sleep = time.sleep  # injection point for tests
 
     # -- transport ----------------------------------------------------------
 
@@ -77,17 +90,39 @@ class PolishClient:
     def metrics(self) -> str:
         return self._request("/metrics").decode()
 
+    def _post_with_retries(
+        self, payload: Dict[str, Any], retries: int
+    ) -> Dict[str, Any]:
+        """POST /polish, sleeping through up to ``retries``
+        :class:`ServerBusy` replies (503: queue full, breaker open, or
+        draining) with the policy's backoff floored by the server's
+        ``Retry-After`` — never failing on the first backpressure
+        response unless asked to (``retries=0``)."""
+        import dataclasses
+
+        policy = dataclasses.replace(
+            self.retry_policy, max_attempts=retries + 1
+        )
+        return json.loads(
+            policy.call(
+                lambda: self._request("/polish", payload),
+                retry_after=lambda e: getattr(e, "retry_after_s", None),
+                sleep=self._sleep,
+            )
+        )
+
     def polish(
         self,
         draft: str,
         positions: np.ndarray,
         examples: np.ndarray,
         contig: str = "seq",
-        retries: int = 0,
+        retries: int = 4,
     ) -> Dict[str, Any]:
-        """Polish one contig from pre-extracted windows. ``retries`` > 0
-        sleeps through :class:`ServerBusy` replies (honouring the
-        server's retry-after) before giving up."""
+        """Polish one contig from pre-extracted windows. ``retries``
+        bounds how many :class:`ServerBusy` replies are slept through
+        (honouring the server's retry-after as a backoff floor) before
+        giving up; 0 surfaces the first busy reply."""
         examples = np.asarray(examples)
         payload = {
             "contig": contig,
@@ -96,24 +131,17 @@ class PolishClient:
             "positions": _b64(positions, np.int64),
             "examples": _b64(examples, np.uint8),
         }
-        for attempt in range(retries + 1):
-            try:
-                return json.loads(self._request("/polish", payload))
-            except ServerBusy as busy:
-                if attempt == retries:
-                    raise
-                time.sleep(busy.retry_after_s)
-        raise AssertionError("unreachable")
+        return self._post_with_retries(payload, retries)
 
     def polish_bam(
-        self, ref: str, bam: str, workers: int = 1, seed: int = 0
+        self, ref: str, bam: str, workers: int = 1, seed: int = 0,
+        retries: int = 4,
     ) -> Dict[str, Any]:
         """Extractor convenience path: ``ref``/``bam`` are paths on the
         SERVER's filesystem; ``seed`` is the row-sampling seed (matches
-        the ``features`` CLI's ``--seed``)."""
-        return json.loads(
-            self._request(
-                "/polish",
-                {"ref": ref, "bam": bam, "workers": workers, "seed": seed},
-            )
+        the ``features`` CLI's ``--seed``). Busy replies retry as in
+        :meth:`polish`."""
+        return self._post_with_retries(
+            {"ref": ref, "bam": bam, "workers": workers, "seed": seed},
+            retries,
         )

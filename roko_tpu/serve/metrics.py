@@ -11,11 +11,17 @@ Exposed series (all prefixed ``roko_serve_``):
 - ``requests_total``, ``windows_total``, ``batches_total``,
   ``rejected_total``, ``errors_total`` — monotonic counters;
 - ``queue_depth`` — gauge, sampled at scrape time;
+- ``cpu_fallback`` — gauge, 1 once a device hang has permanently failed
+  the session over to host-CPU predict (degraded but serving);
 - ``batch_fill_ratio`` — gauge, windows dispatched / padded rows over
   the service lifetime (how much of each padded device batch was real
   work);
 - ``request_latency_seconds{quantile="0.5"|"0.99"}`` + ``_count`` /
-  ``_sum`` — summary over the retained sample window.
+  ``_sum`` — summary over the retained sample window;
+- ``breaker_state`` — gauge, 0 closed / 1 half-open / 2 open — and
+  ``breaker_trips_total`` — counter — when a
+  :class:`roko_tpu.resilience.CircuitBreaker` is attached
+  (docs/SERVING.md "Failure handling").
 """
 
 from __future__ import annotations
@@ -39,6 +45,11 @@ class ServeMetrics:
         self._fill_padded = 0
         #: scrape-time gauge; the batcher points this at its queue
         self.queue_depth: Callable[[], int] = lambda: 0
+        #: scrape-time gauge; make_server points this at the session's
+        #: permanent CPU fail-over flag (``PolishSession.failed_over``)
+        self.cpu_fallback: Callable[[], bool] = lambda: False
+        #: circuit breaker to render state/trips for (set by make_server)
+        self.breaker = None
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -70,6 +81,15 @@ class ServeMetrics:
             f"{_PREFIX}batch_fill_ratio "
             + ("NaN" if fill is None else f"{fill:.4f}")
         )
+        lines.append(f"# TYPE {_PREFIX}cpu_fallback gauge")
+        lines.append(f"{_PREFIX}cpu_fallback {int(bool(self.cpu_fallback()))}")
+        if self.breaker is not None:
+            lines.append(f"# TYPE {_PREFIX}breaker_state gauge")
+            lines.append(f"{_PREFIX}breaker_state {self.breaker.state_code()}")
+            lines.append(f"# TYPE {_PREFIX}breaker_trips_total counter")
+            lines.append(
+                f"{_PREFIX}breaker_trips_total {self.breaker.trip_count}"
+            )
         lat = f"{_PREFIX}request_latency_seconds"
         lines.append(f"# TYPE {lat} summary")
         for q in (50, 99):

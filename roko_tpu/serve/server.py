@@ -17,19 +17,31 @@ Routes (payload schema: docs/SERVING.md):
      — runs the ``features.pipeline`` extractor on the BAM and polishes
      every contig. Returns ``{"contigs": {name: polished}}``.
 
-- ``GET /healthz`` — liveness + the compiled ladder.
+- ``GET /healthz`` — liveness + the compiled ladder. Goes **503** while
+  the circuit breaker is open (device failing) or the server is
+  draining, so a load balancer stops routing here.
 - ``GET /metrics`` — Prometheus text (``serve/metrics.py``).
 
-Backpressure surfaces as **503** with a ``Retry-After`` header; malformed
-payloads as **400**; anything unexpected as **500** with the exception
-type (message stays server-side in the log).
+Backpressure — queue full, breaker open, or draining — surfaces as
+**503** with a ``Retry-After`` header; malformed payloads as **400**;
+anything unexpected as **500** with the exception type (message stays
+server-side in the log).
+
+Shutdown is graceful (docs/SERVING.md "Failure handling"): SIGTERM (or
+:func:`drain`) stops admitting work, lets in-flight requests finish
+under ``resilience.drain_deadline_s``, then exits — no mid-request
+connection resets on a rolling restart.
 """
 
 from __future__ import annotations
 
 import base64
+import contextlib
 import json
+import signal
 import sys
+import threading
+import time
 import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
@@ -39,6 +51,7 @@ import numpy as np
 from roko_tpu import constants as C
 from roko_tpu.config import ServeConfig
 from roko_tpu.infer import VoteBoard
+from roko_tpu.resilience import CircuitBreaker
 from roko_tpu.serve.batcher import Backpressure, MicroBatcher
 from roko_tpu.serve.metrics import ServeMetrics
 from roko_tpu.serve.session import PolishSession
@@ -240,17 +253,46 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply_json(self, code: int, obj: Dict[str, Any], **kw: Any) -> None:
         self._reply(code, json.dumps(obj).encode(), **kw)
 
+    @contextlib.contextmanager
+    def _track_inflight(self):
+        """Count this request in the server's in-flight set so a drain
+        can wait for it (the counter, not thread bookkeeping, is what
+        ``drain`` polls — handler threads are daemons)."""
+        srv = self.server
+        with srv._inflight_lock:
+            srv._inflight += 1
+        try:
+            yield
+        finally:
+            with srv._inflight_lock:
+                srv._inflight -= 1
+
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         if self.path == "/healthz":
             session = self.batcher.session
-            self._reply_json(
-                200,
-                {
-                    "status": "ok",
-                    "ladder": list(session.ladder),
-                    "compiled": session.cache_size(),
-                },
-            )
+            breaker = getattr(self.server, "breaker", None)
+            body: Dict[str, Any] = {
+                "status": "ok",
+                "ladder": list(session.ladder),
+                "compiled": session.cache_size(),
+                # degraded-but-serving: a device hang permanently failed
+                # this session over to host-CPU predict (getattr:
+                # session stand-ins need not model the fail-over)
+                "cpu_fallback": getattr(session, "failed_over", False),
+            }
+            code = 200
+            if breaker is not None:
+                body["breaker"] = breaker.state
+                body["breaker_trips"] = breaker.trip_count
+                if breaker.state == "open":
+                    # the device is failing: a load balancer must stop
+                    # routing here until half-open probing recovers it
+                    body["status"] = "unhealthy"
+                    code = 503
+            if self.server._draining.is_set():
+                body["status"] = "draining"
+                code = 503
+            self._reply_json(code, body)
         elif self.path == "/metrics":
             self._reply(
                 200,
@@ -264,6 +306,25 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/polish":
             self._reply_json(404, {"error": f"no route {self.path}"})
             return
+        with self._track_inflight():
+            # checked AFTER the in-flight increment: drain() watches the
+            # counter, so checking first would let it read 0 and shut
+            # down while this request is between the check and the
+            # increment
+            if self.server._draining.is_set():
+                # draining: in-flight work finishes, NEW work goes
+                # elsewhere
+                self.close_connection = True
+                retry = self.batcher.retry_after_s
+                self._reply_json(
+                    503,
+                    {"error": "server draining", "retry_after_s": retry},
+                    extra={"Retry-After": f"{max(1, round(retry))}"},
+                )
+                return
+            self._handle_polish()
+
+    def _handle_polish(self) -> None:
         try:
             try:
                 length = int(self.headers.get("Content-Length", "0"))
@@ -332,24 +393,38 @@ def make_server(
     *,
     batcher: Optional[MicroBatcher] = None,
     metrics: Optional[ServeMetrics] = None,
+    breaker: Optional[CircuitBreaker] = None,
     host: Optional[str] = None,
     port: Optional[int] = None,
 ) -> ThreadingHTTPServer:
     """Bind (port 0 = ephemeral) and return the server; the caller runs
-    ``serve_forever``. The batcher/metrics ride on the server object
-    (``.batcher`` / ``.metrics``) so tests and the CLI can reach them."""
+    ``serve_forever``. The batcher/metrics/breaker ride on the server
+    object (``.batcher`` / ``.metrics`` / ``.breaker``) so tests and the
+    CLI can reach them."""
     serve_cfg = serve_cfg or session.cfg.serve
+    rcfg = session.cfg.resilience
     metrics = metrics or ServeMetrics(latency_samples=serve_cfg.latency_samples)
-    # the default batcher takes its knobs from the EXPLICIT serve_cfg —
-    # MicroBatcher's own defaults read session.cfg.serve, which may be a
-    # different config object than the one passed here
-    batcher = batcher or MicroBatcher(
-        session,
-        metrics=metrics,
-        max_queue=serve_cfg.max_queue,
-        max_delay_ms=serve_cfg.max_delay_ms,
-        retry_after_s=serve_cfg.retry_after_s,
-    )
+    if batcher is None:
+        if breaker is None and rcfg.breaker_failures > 0:
+            breaker = CircuitBreaker(
+                failure_threshold=rcfg.breaker_failures,
+                reset_s=rcfg.breaker_reset_s,
+            )
+        # the default batcher takes its knobs from the EXPLICIT
+        # serve_cfg — MicroBatcher's own defaults read session.cfg.serve,
+        # which may be a different config object than the one passed here
+        batcher = MicroBatcher(
+            session,
+            metrics=metrics,
+            breaker=breaker,
+            max_queue=serve_cfg.max_queue,
+            max_delay_ms=serve_cfg.max_delay_ms,
+            retry_after_s=serve_cfg.retry_after_s,
+        )
+    else:
+        breaker = breaker or batcher.breaker
+    metrics.breaker = breaker
+    metrics.cpu_fallback = lambda: getattr(session, "failed_over", False)
     handler = type("RokoServeHandler", (_Handler,), {
         "batcher": batcher, "metrics": metrics,
         "data_root": serve_cfg.data_root,
@@ -363,14 +438,74 @@ def make_server(
     server.batcher = batcher  # type: ignore[attr-defined]
     server.metrics = metrics  # type: ignore[attr-defined]
     server.session = session  # type: ignore[attr-defined]
+    server.breaker = breaker  # type: ignore[attr-defined]
+    server._draining = threading.Event()  # type: ignore[attr-defined]
+    server._inflight = 0  # type: ignore[attr-defined]
+    server._inflight_lock = threading.Lock()  # type: ignore[attr-defined]
+    server.drain_deadline_s = rcfg.drain_deadline_s  # type: ignore[attr-defined]
     return server
 
 
+def drain(
+    server: ThreadingHTTPServer,
+    deadline_s: Optional[float] = None,
+    log=print,
+) -> bool:
+    """Graceful shutdown: reject NEW ``/polish`` work with 503 +
+    ``Retry-After`` immediately, wait up to ``deadline_s`` for in-flight
+    requests to finish, then stop the accept loop. Returns True when
+    every in-flight request completed inside the deadline. Idempotent —
+    a second SIGTERM while draining is a no-op."""
+    if server._draining.is_set():  # type: ignore[attr-defined]
+        return True
+    if deadline_s is None:
+        deadline_s = getattr(server, "drain_deadline_s", 20.0)
+    server._draining.set()  # type: ignore[attr-defined]
+    log(
+        f"roko serve: draining — rejecting new work, waiting up to "
+        f"{deadline_s:.0f}s for in-flight requests"
+    )
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        with server._inflight_lock:  # type: ignore[attr-defined]
+            left = server._inflight  # type: ignore[attr-defined]
+        if left == 0:
+            break
+        time.sleep(0.05)
+    with server._inflight_lock:  # type: ignore[attr-defined]
+        left = server._inflight  # type: ignore[attr-defined]
+    if left:
+        log(
+            f"roko serve: drain deadline expired with {left} request(s) "
+            "still in flight; shutting down anyway"
+        )
+    else:
+        log("roko serve: drained clean")
+    server.shutdown()
+    return left == 0
+
+
 def serve_forever(server: ThreadingHTTPServer, log=print) -> None:
-    """Blocking loop with clean shutdown on Ctrl-C."""
+    """Blocking loop with clean shutdown on Ctrl-C and a graceful
+    SIGTERM drain (finish in-flight, reject new, then exit)."""
     host, port = server.server_address[:2]
     log(f"roko serve: listening on http://{host}:{port} "
         f"(POST /polish, GET /healthz, GET /metrics)")
+
+    def _on_sigterm(signum, frame):
+        # drain blocks (and calls shutdown, which must not run on the
+        # serve_forever thread) — hand it to a worker
+        threading.Thread(
+            target=drain, args=(server,), kwargs={"log": log},
+            name="roko-serve-drain", daemon=True,
+        ).start()
+
+    try:
+        # only the main thread may set signal handlers; tests drive
+        # serve_forever from worker threads and call drain() directly
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass
     try:
         server.serve_forever()
     except KeyboardInterrupt:

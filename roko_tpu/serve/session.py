@@ -17,6 +17,7 @@ window counts).
 
 from __future__ import annotations
 
+import sys
 import threading
 from typing import Any, Dict, Optional, Sequence, Set, Tuple
 
@@ -25,8 +26,14 @@ import numpy as np
 from jax.sharding import Mesh
 
 from roko_tpu.config import RokoConfig
-from roko_tpu.infer import make_predict_step, pad_windows, rung_for
+from roko_tpu.infer import (
+    make_cpu_predict,
+    make_predict_step,
+    pad_windows,
+    rung_for,
+)
 from roko_tpu.models.model import RokoModel
+from roko_tpu.resilience import HangError, call_with_deadline
 from roko_tpu.parallel.mesh import (
     AXIS_DP,
     data_sharding,
@@ -68,6 +75,14 @@ class PolishSession:
             )
         self.ladder: Tuple[int, ...] = rungs
         self.model = RokoModel(self.cfg.model)
+        self.resilience = self.cfg.resilience
+        # host-side params copy for the CPU hang fail-over (taken now,
+        # while the device is known-good; after a hang a device_get of
+        # the resident params would itself hang)
+        self._params_host = (
+            params if self.resilience.hang_fallback == "cpu" else None
+        )
+        self._cpu_predict = None  # built on first fail-over
         self.params = jax.device_put(params, replicated_sharding(self.mesh))
         self._step = make_predict_step(self.model, self.mesh)
         self._sharding = data_sharding(self.mesh)
@@ -116,9 +131,49 @@ class PolishSession:
         return full * top + (self.rung_for(rest) if rest else 0)
 
     def _dispatch(self, x: np.ndarray) -> np.ndarray:
+        """One padded batch through the device, under the resilience
+        watchdog (roko_tpu/resilience): a compile/predict call that
+        outlives ``resilience.predict_deadline_s`` dumps thread stacks
+        and raises :class:`HangError` — the batcher's circuit breaker
+        counts it as a device failure — or, with ``hang_fallback ==
+        "cpu"``, the session permanently fails over to a host-CPU
+        predict step and keeps serving (degraded)."""
         self.dispatched_shapes.add(x.shape[0])
-        fut = self._step(self.params, jax.device_put(x, self._sharding))
-        return np.asarray(jax.device_get(fut))
+        if self._cpu_predict is not None:
+            return self._cpu_predict(x)
+
+        def run() -> np.ndarray:
+            fut = self._step(self.params, jax.device_put(x, self._sharding))
+            return np.asarray(jax.device_get(fut))
+
+        try:
+            return call_with_deadline(
+                run,
+                self.resilience.predict_deadline_s,
+                stage="serve-predict",
+            )
+        except HangError:
+            if self.resilience.hang_fallback != "cpu":
+                raise
+            print(
+                "ROKO_FAILOVER serve: device hang — session permanently "
+                "failed over to host-CPU predict (degraded); healthz "
+                "cpu_fallback=true, metrics roko_serve_cpu_fallback=1",
+                file=sys.stderr,
+                flush=True,
+            )
+            self._cpu_predict = make_cpu_predict(
+                self.model, self._params_host
+            )
+            return self._cpu_predict(x)
+
+    @property
+    def failed_over(self) -> bool:
+        """True once a device hang has permanently switched this session
+        onto the host-CPU predict path (``hang_fallback == "cpu"``) —
+        surfaced in ``/healthz`` and the ``roko_serve_cpu_fallback``
+        gauge so a degraded-but-serving process is visible to operators."""
+        return self._cpu_predict is not None
 
     def predict(self, x: np.ndarray) -> np.ndarray:
         """uint8[n, rows, cols] -> int32[n, cols] class ids, padding to

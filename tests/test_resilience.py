@@ -1,0 +1,958 @@
+"""Resilient-runtime tests (roko_tpu/resilience; ISSUE 3).
+
+The acceptance bars, each asserted here or in the slow tier:
+
+- **Hang injection**: a predict fn that blocks forever trips the
+  watchdog within the configured deadline, produces the thread-stack
+  diagnostic, and the run fails loudly (or falls over to CPU when
+  configured) — no leaked non-daemon threads, no hang.
+- **Crash resume**: a run killed mid-polish, rerun with ``resume``,
+  yields a byte-identical FASTA to an uninterrupted run, and committed
+  contigs are not re-extracted (journal skip count; the SIGKILL
+  subprocess variant lives in the slow tier).
+- **Serve degradation**: drain rejects new work with 503 while
+  in-flight requests finish; N consecutive injected device failures
+  trip the circuit breaker (healthz 503, metrics counters) and a
+  successful half-open probe restores service.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    ResilienceConfig,
+    RokoConfig,
+    ServeConfig,
+)
+from roko_tpu.infer import rung_for
+from roko_tpu.models.model import RokoModel
+from roko_tpu.pipeline import run_streaming_polish
+from roko_tpu.pipeline import stream as stream_mod
+from roko_tpu.resilience import (
+    CircuitBreaker,
+    HangError,
+    JournalMismatch,
+    PolishJournal,
+    RetryPolicy,
+    call_with_deadline,
+)
+from roko_tpu.serve import (
+    MicroBatcher,
+    PolishClient,
+    ServeMetrics,
+    ServerBusy,
+    drain,
+    make_server,
+)
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+# -- RetryPolicy -------------------------------------------------------------
+
+
+def test_retry_policy_retries_then_succeeds():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    seen = []
+    policy = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.0)
+    out = policy.call(
+        flaky,
+        on_retry=lambda n, e, d: seen.append((n, type(e).__name__, d)),
+        sleep=lambda s: None,
+    )
+    assert out == "ok"
+    assert len(attempts) == 3
+    # exponential backoff: 0.1, then 0.2
+    assert seen == [(1, "OSError", 0.1), (2, "OSError", pytest.approx(0.2))]
+
+
+def test_retry_policy_exhausts_and_raises():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise OSError("persistent")
+
+    with pytest.raises(OSError, match="persistent"):
+        RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0).call(
+            broken, sleep=lambda s: None
+        )
+    assert len(calls) == 3  # max_attempts is a TOTAL budget
+
+
+def test_retry_policy_passes_non_retryable_through():
+    policy = RetryPolicy(max_attempts=5, retryable=(OSError,))
+    calls = []
+
+    def wrong_kind():
+        calls.append(1)
+        raise ValueError("not retryable")
+
+    with pytest.raises(ValueError):
+        policy.call(wrong_kind, sleep=lambda s: None)
+    assert len(calls) == 1
+
+
+def test_retry_policy_honors_retry_after_floor():
+    """A server-demanded Retry-After floors the backoff (the 503
+    contract), and max_delay_s caps the policy's own growth."""
+    policy = RetryPolicy(
+        max_attempts=2, base_delay_s=0.1, max_delay_s=5.0, jitter=0.0
+    )
+    assert policy.delay_for(1, floor_s=3.0) == 3.0  # floor wins over 0.1
+    assert policy.delay_for(1) == pytest.approx(0.1)
+    assert policy.delay_for(10) == 5.0  # capped
+    # jitter only ever ADDS on top of the floor
+    jittered = RetryPolicy(base_delay_s=0.1, jitter=0.5).delay_for(
+        1, floor_s=2.0
+    )
+    assert 2.0 <= jittered <= 3.0
+
+
+# -- watchdog ----------------------------------------------------------------
+
+
+def test_call_with_deadline_passes_results_and_errors():
+    assert call_with_deadline(lambda: 41 + 1, 5.0, stage="ok") == 42
+
+    def boom():
+        raise KeyError("inner")
+
+    with pytest.raises(KeyError, match="inner"):
+        call_with_deadline(boom, 5.0, stage="err")
+    # deadline <= 0 disables the watchdog entirely (inline call)
+    before = threading.active_count()
+    assert call_with_deadline(lambda: "x", 0.0) == "x"
+    assert threading.active_count() == before
+
+
+def test_watchdog_fires_on_blocking_call():
+    """The r5 wedge shape: a call that never returns must surface as
+    HangError within the deadline, with the parseable diagnostic and
+    the thread-stack dump — and leak no non-daemon threads."""
+    non_daemon_before = {
+        t for t in threading.enumerate() if not t.daemon
+    }
+    lines = []
+    t0 = time.monotonic()
+    with pytest.raises(HangError, match="deadline"):
+        call_with_deadline(
+            lambda: threading.Event().wait(),  # blocks forever
+            0.3,
+            stage="fake-compile",
+            log=lines.append,
+        )
+    assert time.monotonic() - t0 < 5.0  # fired near the deadline, no hang
+    joined = "\n".join(lines)
+    assert "ROKO_WATCHDOG hang stage=fake-compile deadline_s=0.3" in joined
+    assert "fake-compile" in joined and "wait" in joined  # stack dump
+    assert {
+        t for t in threading.enumerate() if not t.daemon
+    } == non_daemon_before
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_trips_after_consecutive_failures():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=3, reset_s=10.0, clock=lambda: clock[0])
+    assert b.state == "closed" and b.allow()
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # success resets the consecutive count
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()  # third consecutive
+    assert b.state == "open"
+    assert b.trip_count == 1
+    assert not b.allow()
+    assert 0.0 < b.retry_after_s() <= 10.0
+
+
+def test_breaker_half_open_probe_and_recovery():
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=1, reset_s=5.0, clock=lambda: clock[0])
+    b.record_failure()
+    assert b.state == "open" and not b.allow()
+    clock[0] = 6.0
+    assert b.state == "half-open"
+    assert b.allow()  # the single probe slot
+    assert not b.allow()  # second request denied while probe in flight
+    b.record_success()
+    assert b.state == "closed" and b.allow()
+    # and the failure path: a failed probe re-opens for another reset_s
+    b.record_failure()
+    clock[0] = 12.0
+    assert b.allow()
+    b.record_failure()
+    assert b.state == "open"
+    assert b.trip_count == 3  # initial + re-trip after failed probe
+    # an aborted probe (breaker claimed, request never enqueued) must
+    # release the slot or half-open wedges forever
+    clock[0] = 20.0
+    assert b.allow()
+    b.cancel_probe()
+    assert b.allow()
+
+
+# -- journal -----------------------------------------------------------------
+
+
+def test_journal_commit_load_round_trip(tmp_path):
+    out = str(tmp_path / "polished.fasta")
+    meta = {"ref": "r.fa", "bam": "x.bam", "seed": 5}
+    j = PolishJournal(out)
+    assert j.open(meta, resume=False) == {}
+    j.commit("zulu", "ACGT" * 10, 7)
+    j.commit("alpha", "", 0)  # empty sequences commit too
+    j.close()
+
+    j2 = PolishJournal(out)
+    committed = j2.open(meta, resume=True)
+    assert committed == {"zulu": ("ACGT" * 10, 7), "alpha": ("", 0)}
+    j2.finalize()
+    assert not (tmp_path / "polished.fasta.resume").exists()
+
+
+def test_journal_ignores_torn_manifest_tail(tmp_path):
+    """A SIGKILL mid-append leaves a torn trailing line: it must read as
+    'not committed', never as corruption."""
+    out = str(tmp_path / "p.fasta")
+    meta = {"ref": "r", "bam": "b", "seed": 0}
+    j = PolishJournal(out)
+    j.open(meta, resume=False)
+    j.commit("good", "AAAA", 3)
+    j.close()
+    with open(j.manifest_path, "a") as fh:
+        fh.write('{"contig": "torn", "fi')  # crash mid-append
+    committed = PolishJournal(out).open(meta, resume=True)
+    assert committed == {"good": ("AAAA", 3)}
+
+
+def test_journal_refuses_foreign_run(tmp_path):
+    out = str(tmp_path / "p.fasta")
+    j = PolishJournal(out)
+    j.open({"ref": "r", "bam": "b", "seed": 0}, resume=False)
+    j.commit("c", "A", 1)
+    j.close()
+    with pytest.raises(JournalMismatch, match="different run"):
+        PolishJournal(out).open(
+            {"ref": "r", "bam": "b", "seed": 1}, resume=True
+        )
+    # a NON-resume run over the same path starts clean instead
+    fresh = PolishJournal(out).open(
+        {"ref": "r", "bam": "b", "seed": 1}, resume=False
+    )
+    assert fresh == {}
+
+
+def test_journal_identity_covers_params_and_geometry(tmp_path):
+    """The run identity is not just ref/bam/seed: a resume under
+    different model weights or window geometry would silently splice
+    two different polishes into one FASTA, so it must be refused."""
+    import dataclasses
+
+    from roko_tpu.config import WindowConfig
+    from roko_tpu.pipeline.stream import _journal_identity
+
+    cfg = RokoConfig()
+    params = {"layer": {"w": np.arange(6, dtype=np.float32).reshape(2, 3)}}
+    base = {"ref": "r", "bam": "b", "seed": 0}
+    out = str(tmp_path / "p.fasta")
+    j = PolishJournal(out)
+    j.open(dict(base, **_journal_identity(cfg, params)), resume=False)
+    j.commit("ctg", "ACGT", 3)
+    j.close()
+
+    # identical weights + config resume fine (tuple-typed config fields
+    # must survive the meta.json round-trip)
+    same = PolishJournal(out).open(
+        dict(base, **_journal_identity(cfg, params)), resume=True
+    )
+    assert same == {"ctg": ("ACGT", 3)}
+
+    bumped = {"layer": {"w": params["layer"]["w"] + 1}}
+    with pytest.raises(JournalMismatch):
+        PolishJournal(out).open(
+            dict(base, **_journal_identity(cfg, bumped)), resume=True
+        )
+    other_geom = dataclasses.replace(cfg, window=WindowConfig(rows=100))
+    with pytest.raises(JournalMismatch):
+        PolishJournal(out).open(
+            dict(base, **_journal_identity(other_geom, params)), resume=True
+        )
+
+
+# -- streaming-engine integration -------------------------------------------
+
+
+# real predict runs keep the default (generous) watchdog deadline — the
+# first compile on a loaded 2-core CI box can take seconds; only the
+# runs whose predict is a DELIBERATELY blocking fake use HANG_CFG
+CFG = RokoConfig(model=TINY, mesh=MeshConfig(dp=8))
+HANG_CFG = RokoConfig(
+    model=TINY,
+    mesh=MeshConfig(dp=8),
+    resilience=ResilienceConfig(predict_deadline_s=0.5),
+)
+
+
+def _synthetic_source(rng, n_contigs=2, windows_each=12):
+    """Region sources with valid genome-ordered windows — no BAM, no
+    extraction: the resilience tests target the predict loop."""
+    refs, results, counts = [], [], {}
+    for ci in range(n_contigs):
+        name = f"ctg{ci}"
+        draft_len = windows_each * C.WINDOW_STRIDE + C.WINDOW_COLS + 10
+        refs.append((name, "".join(rng.choice(list("ACGT"), draft_len))))
+        positions = np.zeros((windows_each, C.WINDOW_COLS, 2), np.int64)
+        for i in range(windows_each):
+            positions[i, :, 0] = np.arange(
+                i * C.WINDOW_STRIDE, i * C.WINDOW_STRIDE + C.WINDOW_COLS
+            )
+        x = rng.integers(
+            0, C.FEATURE_VOCAB,
+            (windows_each, C.WINDOW_ROWS, C.WINDOW_COLS),
+        ).astype(np.uint8)
+        results.append((name, positions, x, None))
+        counts[name] = 1
+    return refs, counts, results
+
+
+def _source(refs, counts, results):
+    return SimpleNamespace(
+        refs=refs, region_counts=dict(counts), results=iter(results)
+    )
+
+
+@pytest.fixture(scope="module")
+def synthetic():
+    rng = np.random.default_rng(42)
+    refs, counts, results = _synthetic_source(rng)
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    return SimpleNamespace(
+        refs=refs, counts=counts, results=results, params=params
+    )
+
+
+def _blocking_predict_step(model, mesh):
+    def predict(params, x):
+        threading.Event().wait()  # a compile that never returns
+
+    return predict
+
+
+def test_streaming_hang_watchdog_aborts(synthetic, monkeypatch, tmp_path):
+    """ISSUE acceptance: a forever-blocking predict trips the watchdog
+    within the deadline, logs the stack diagnostic, fails the run
+    (nonzero exit through the CLI), and tears down without deadlock or
+    non-daemon thread leaks."""
+    monkeypatch.setattr(stream_mod, "make_predict_step", _blocking_predict_step)
+    non_daemon_before = {t for t in threading.enumerate() if not t.daemon}
+    out = str(tmp_path / "never.fasta")
+    msgs = []
+    t0 = time.monotonic()
+    with pytest.raises(HangError, match="pipeline-predict-dispatch"):
+        run_streaming_polish(
+            None, None, synthetic.params, HANG_CFG,
+            out_path=out, batch_size=16, log=msgs.append,
+            region_source=_source(
+                synthetic.refs, synthetic.counts, synthetic.results
+            ),
+        )
+    assert time.monotonic() - t0 < 30.0  # no hang, no deadlocked teardown
+    joined = "\n".join(msgs)
+    assert "ROKO_WATCHDOG hang stage=pipeline-predict-dispatch" in joined
+    # no half-written output, and the journal survives for --resume
+    assert not (tmp_path / "never.fasta").exists()
+    assert (tmp_path / "never.fasta.resume").is_dir()
+    assert {
+        t for t in threading.enumerate() if not t.daemon
+    } == non_daemon_before
+
+
+def test_streaming_hang_falls_over_to_cpu(synthetic, monkeypatch, tmp_path):
+    """With hang_fallback=cpu the same wedged device yields a COMPLETED
+    run whose output is byte-identical to a healthy one."""
+    import dataclasses
+
+    clean_out = str(tmp_path / "clean.fasta")
+    clean = run_streaming_polish(
+        None, None, synthetic.params, CFG,
+        out_path=clean_out, batch_size=16, log=lambda *a: None,
+        region_source=_source(
+            synthetic.refs, synthetic.counts, synthetic.results
+        ),
+    )
+    assert not (tmp_path / "clean.fasta.resume").exists()  # finalized
+
+    monkeypatch.setattr(stream_mod, "make_predict_step", _blocking_predict_step)
+    cfg = dataclasses.replace(
+        HANG_CFG,
+        resilience=ResilienceConfig(
+            predict_deadline_s=0.5, hang_fallback="cpu"
+        ),
+    )
+    out = str(tmp_path / "fallback.fasta")
+    msgs = []
+    polished = run_streaming_polish(
+        None, None, synthetic.params, cfg,
+        out_path=out, batch_size=16, log=msgs.append,
+        region_source=_source(
+            synthetic.refs, synthetic.counts, synthetic.results
+        ),
+    )
+    assert polished == clean
+    assert open(out, "rb").read() == open(clean_out, "rb").read()
+    joined = "\n".join(msgs)
+    assert "ROKO_WATCHDOG hang" in joined
+    assert "failing over to the host CPU" in joined
+
+
+def test_streaming_resume_skips_committed_contigs(synthetic, tmp_path):
+    """Crash after one contig committed; the resume run skips it (skip
+    log + producer never re-votes it) and the final FASTA is
+    byte-identical to an uninterrupted run."""
+    clean_out = str(tmp_path / "clean.fasta")
+    run_streaming_polish(
+        None, None, synthetic.params, CFG,
+        out_path=clean_out, batch_size=16, log=lambda *a: None,
+        region_source=_source(
+            synthetic.refs, synthetic.counts, synthetic.results
+        ),
+    )
+
+    out = str(tmp_path / "crashy.fasta")
+    committed_evt = threading.Event()
+    msgs = []
+
+    def log(m):
+        msgs.append(m)
+        if "committed contig ctg0" in m:
+            committed_evt.set()
+
+    def faulting():
+        # ctg0's whole block + done notice, then ctg1's block (the
+        # one-deep predict pipeline drains batch k only when batch k+1
+        # exists — without a second item ctg0 would never finish), then
+        # wait for the consumer to durably commit ctg0 before crashing:
+        # deterministic "died mid-run with one contig landed"
+        yield synthetic.results[0]
+        yield synthetic.results[1]
+        assert committed_evt.wait(30.0), "ctg0 was never committed"
+        raise RuntimeError("injected crash after first commit")
+
+    with pytest.raises(RuntimeError, match="injected crash"):
+        run_streaming_polish(
+            None, None, synthetic.params, CFG,
+            out_path=out, batch_size=16, log=log,
+            region_source=SimpleNamespace(
+                refs=synthetic.refs,
+                region_counts=dict(synthetic.counts),
+                results=faulting(),
+            ),
+        )
+    assert (tmp_path / "crashy.fasta.resume").is_dir()
+    assert not (tmp_path / "crashy.fasta").exists()  # no torn FASTA
+
+    msgs2 = []
+    polished = run_streaming_polish(
+        None, None, synthetic.params, CFG,
+        out_path=out, batch_size=16, log=msgs2.append, resume=True,
+        region_source=_source(
+            synthetic.refs, synthetic.counts, synthetic.results
+        ),
+    )
+    assert any("resume: skipping 1 committed contig" in m for m in msgs2)
+    # the skipped contig was not re-voted: only ctg1's windows flowed
+    n_ctg1 = len(synthetic.results[1][1])
+    assert any(f"extracted {n_ctg1} windows" in m for m in msgs2)
+    assert open(out, "rb").read() == open(clean_out, "rb").read()
+    assert sorted(polished) == sorted(n for n, _ in synthetic.refs)
+    assert not (tmp_path / "crashy.fasta.resume").exists()  # finalized
+
+
+def test_streaming_resume_rejects_other_inputs(synthetic, tmp_path):
+    out = str(tmp_path / "p.fasta")
+    committed_evt = threading.Event()
+
+    def log(m):
+        if "committed contig" in m:
+            committed_evt.set()
+
+    def faulting():
+        yield synthetic.results[0]
+        yield synthetic.results[1]
+        committed_evt.wait(30.0)
+        raise RuntimeError("crash")
+
+    with pytest.raises(RuntimeError):
+        run_streaming_polish(
+            None, None, synthetic.params, CFG, out_path=out,
+            batch_size=16, log=log, seed=0,
+            region_source=SimpleNamespace(
+                refs=synthetic.refs,
+                region_counts=dict(synthetic.counts),
+                results=faulting(),
+            ),
+        )
+    with pytest.raises(JournalMismatch):
+        run_streaming_polish(
+            None, None, synthetic.params, CFG, out_path=out,
+            batch_size=16, log=lambda *a: None, seed=1,  # different run
+            resume=True,
+            region_source=_source(
+                synthetic.refs, synthetic.counts, synthetic.results
+            ),
+        )
+
+
+def test_streaming_resume_flag_validation(synthetic, tmp_path):
+    with pytest.raises(ValueError, match="output path"):
+        run_streaming_polish(
+            None, None, synthetic.params, CFG, resume=True,
+            region_source=_source(
+                synthetic.refs, synthetic.counts, synthetic.results
+            ),
+        )
+    with pytest.raises(ValueError, match="tee"):
+        run_streaming_polish(
+            None, None, synthetic.params, CFG, resume=True,
+            out_path=str(tmp_path / "o.fasta"),
+            tee_hdf5=str(tmp_path / "t.h5"),
+            region_source=_source(
+                synthetic.refs, synthetic.counts, synthetic.results
+            ),
+        )
+
+
+# -- SIGKILL resume (the full crash story, subprocess tier) ------------------
+
+
+_CHILD_POLISH = """\
+import sys
+
+sys.path.insert(0, {repo_root!r})
+
+# Counter-override any sitecustomize TPU registration through the live
+# config, same as tests/conftest.py (see _CHILD_TRAIN in
+# test_fault_injection.py for why the env var alone is not enough).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from roko_tpu.config import MeshConfig, ModelConfig, RegionConfig, RokoConfig
+from roko_tpu.models.model import RokoModel
+from roko_tpu.pipeline import run_streaming_polish
+
+ref, bam, out = sys.argv[1:4]
+resume = "--resume" in sys.argv[4:]
+cfg = RokoConfig(
+    model=ModelConfig(
+        embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+    ),
+    mesh=MeshConfig(dp=8),
+    region=RegionConfig(size=1200, overlap=100),
+)
+params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+run_streaming_polish(
+    ref, bam, params, cfg, out_path=out, seed=5, batch_size=16,
+    log=lambda m: print(m, flush=True), resume=resume,
+)
+print("POLISH_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_polish_survives_sigkill_with_resume(tmp_path):
+    """ISSUE acceptance (the real thing, not the in-process rehearsal):
+    kill -9 a streaming polish right after its first contig commits,
+    rerun the same command with resume, and the final FASTA must be
+    byte-identical to a single uninterrupted run — with the committed
+    contig(s) skipped, not re-extracted (fewer windows extracted on the
+    resumed run, skip line present)."""
+    import os
+    import random
+    import re
+    import subprocess
+    import sys as _sys
+
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+
+    from .helpers import random_seq, simulate_reads
+
+    rng = random.Random(11)
+    drafts = [(name, random_seq(rng, 2500)) for name in ("aa", "bb", "cc")]
+    fasta = str(tmp_path / "draft.fasta")
+    write_fasta(fasta, drafts)
+    reads = []
+    for tid, (_, seq) in enumerate(drafts):
+        reads += simulate_reads(rng, seq, tid, coverage=10, read_len=300)
+    bam = str(tmp_path / "reads.bam")
+    write_sorted_bam(bam, [(n, len(s)) for n, s in drafts], reads)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child_polish.py"
+    script.write_text(_CHILD_POLISH.format(repo_root=repo_root))
+    out_killed = str(tmp_path / "killed.fasta")
+    cmd = [_sys.executable, str(script), fasta, bam, out_killed]
+
+    # run 1: SIGKILL the moment the first contig's durable commit is
+    # announced — the journal holds that contig, the FASTA is torn
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, bufsize=1, cwd=repo_root,
+    )
+    killed = False
+    lines = []
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        lines.append(line)
+        if "polish: committed contig" in line:
+            proc.kill()
+            killed = True
+            break
+    proc.wait(timeout=60)
+    assert killed, (
+        "child finished before the kill landed; output:\n"
+        + "".join(lines[-30:])
+    )
+    journal_dir = tmp_path / "killed.fasta.resume"
+    assert journal_dir.is_dir()  # the durable state the resume feeds on
+
+    # run 2: same command + --resume; must skip the committed contig(s)
+    # and run to completion
+    done = subprocess.run(
+        cmd + ["--resume"], capture_output=True, text=True,
+        cwd=repo_root, timeout=900,
+    )
+    assert done.returncode == 0, done.stdout + done.stderr
+    assert "POLISH_DONE" in done.stdout
+    m = re.search(
+        r"resume: skipping (\d+) committed contig\(s\) \((\d+) windows\)",
+        done.stdout,
+    )
+    assert m, done.stdout
+    skipped = int(m.group(1))
+    assert 1 <= skipped < len(drafts)
+    assert not journal_dir.exists()  # finalized after the whole run
+
+    # uninterrupted reference run (in-process; jax is already warm)
+    from roko_tpu.config import RegionConfig
+
+    cfg = RokoConfig(
+        model=TINY, mesh=MeshConfig(dp=8),
+        region=RegionConfig(size=1200, overlap=100),
+    )
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    clean_out = str(tmp_path / "clean.fasta")
+    clean_msgs = []
+    run_streaming_polish(
+        fasta, bam, params, cfg, out_path=clean_out, seed=5,
+        batch_size=16, log=clean_msgs.append,
+    )
+    assert open(out_killed, "rb").read() == open(clean_out, "rb").read()
+
+    # committed contigs were NOT re-extracted: the resumed run saw
+    # strictly fewer windows than the uninterrupted one
+    def extracted(msgs):
+        for msg in msgs:
+            hit = re.search(r"extracted (\d+) windows", msg)
+            if hit:
+                return int(hit.group(1))
+        raise AssertionError(f"no extraction count in {msgs[-5:]}")
+
+    n_resumed = extracted(done.stdout.splitlines())
+    n_clean = extracted(clean_msgs)
+    assert 0 < n_resumed < n_clean
+
+
+# -- serve degradation -------------------------------------------------------
+
+
+SERVE_CFG = RokoConfig(
+    model=TINY,
+    mesh=MeshConfig(dp=8),
+    serve=ServeConfig(ladder=(8, 16), max_delay_ms=5.0, max_queue=8),
+    resilience=ResilienceConfig(breaker_failures=2, breaker_reset_s=0.2),
+)
+
+
+class FakeSession:
+    """PolishSession stand-in: no jax, failure/delay injection."""
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.ladder = cfg.serve.ladder
+        self.fail = False
+        self.delay_s = 0.0
+        self.calls = 0
+
+    def cache_size(self):
+        return len(self.ladder)
+
+    def rung_for(self, n):
+        return rung_for(self.ladder, n)
+
+    def padded_size(self, n):
+        top = self.ladder[-1]
+        full, rest = divmod(n, top)
+        return full * top + (self.rung_for(rest) if rest else 0)
+
+    def predict(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if self.fail:
+            raise RuntimeError("injected device failure")
+        return np.zeros((len(x), C.WINDOW_COLS), np.int32)
+
+
+def _windows(rng, n):
+    x = rng.integers(
+        0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    positions = np.zeros((n, C.WINDOW_COLS, 2), np.int64)
+    for i in range(n):
+        positions[i, :, 0] = np.arange(
+            i * C.WINDOW_STRIDE, i * C.WINDOW_STRIDE + C.WINDOW_COLS
+        )
+    return positions, x
+
+
+def _get(url):
+    """Raw GET that returns (status, parsed body) without the client's
+    503 -> ServerBusy mapping (healthz 503 is a STATUS here, not
+    backpressure)."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture
+def degraded_server():
+    session = FakeSession(SERVE_CFG)
+    srv = make_server(session, SERVE_CFG.serve, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    base = f"http://127.0.0.1:{srv.server_address[1]}"
+    yield SimpleNamespace(
+        srv=srv, session=session, base=base,
+        client=PolishClient(base),
+    )
+    srv.shutdown()
+    srv.batcher.stop()
+    srv.server_close()
+    thread.join(5.0)
+
+
+def test_breaker_trips_unhealthy_then_half_open_recovers(degraded_server, rng):
+    """ISSUE acceptance: N consecutive injected device failures trip the
+    breaker (healthz 503, metrics trip counter, /polish sheds with
+    Retry-After); a successful half-open probe restores service."""
+    s = degraded_server
+    draft = "".join(rng.choice(list("ACGT"), 200))
+    positions, x = _windows(rng, 2)
+
+    status, body = _get(s.base + "/healthz")
+    assert (status, body["breaker"]) == (200, "closed")
+
+    s.session.fail = True
+    for _ in range(2):  # breaker_failures=2 consecutive device failures
+        with pytest.raises(RuntimeError, match="HTTP 500"):
+            s.client.polish(draft, positions, x, retries=0)
+    status, body = _get(s.base + "/healthz")
+    assert status == 503
+    assert body["status"] == "unhealthy" and body["breaker"] == "open"
+    assert body["breaker_trips"] == 1
+    text = s.client.metrics()
+    assert "roko_serve_breaker_state 2" in text
+    assert "roko_serve_breaker_trips_total 1" in text
+
+    # open breaker sheds load WITHOUT touching the device (ServerBusy
+    # carries the parsed Retry-After; the reason rides the 503 body)
+    calls_before = s.session.calls
+    with pytest.raises(ServerBusy):
+        s.client.polish(draft, positions, x, retries=0)
+    assert s.session.calls == calls_before
+
+    # device recovers; after reset_s the half-open probe re-closes it
+    s.session.fail = False
+    time.sleep(0.25)
+    reply = s.client.polish(draft, positions, x, retries=0)
+    assert reply["windows"] == 2
+    status, body = _get(s.base + "/healthz")
+    assert (status, body["breaker"]) == (200, "closed")
+    assert "roko_serve_breaker_state 0" in s.client.metrics()
+
+
+def test_drain_finishes_inflight_and_rejects_new(degraded_server, rng):
+    """ISSUE acceptance: drain (the SIGTERM path) completes in-flight
+    requests and rejects new ones with 503 + Retry-After."""
+    s = degraded_server
+    s.session.delay_s = 0.6
+    draft = "".join(rng.choice(list("ACGT"), 200))
+    positions, x = _windows(rng, 1)
+
+    results = {}
+
+    def inflight():
+        results["reply"] = s.client.polish(draft, positions, x, retries=0)
+
+    t = threading.Thread(target=inflight, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:  # wait until it is really in flight
+        with s.srv._inflight_lock:
+            if s.srv._inflight:
+                break
+        time.sleep(0.01)
+
+    drained = {}
+
+    def run_drain():
+        drained["clean"] = drain(s.srv, deadline_s=10.0, log=lambda *a: None)
+
+    dt = threading.Thread(target=run_drain, daemon=True)
+    dt.start()
+    deadline = time.monotonic() + 5.0
+    while not s.srv._draining.is_set() and time.monotonic() < deadline:
+        time.sleep(0.01)
+
+    # new work is rejected immediately while the old completes
+    with pytest.raises(ServerBusy):
+        s.client.polish(draft, positions, x, retries=0)
+    status, body = _get(s.base + "/healthz")
+    assert status == 503 and body["status"] == "draining"
+
+    t.join(15.0)
+    dt.join(15.0)
+    assert not dt.is_alive() and drained["clean"] is True
+    assert results["reply"]["windows"] == 1  # in-flight request finished
+
+
+def test_sigterm_drains_and_exits_serve_forever():
+    """The real SIGTERM path: pytest runs on the main thread, so
+    serve_forever installs its handler here; a SIGTERM to ourselves
+    must drain and return instead of killing the process."""
+    import os
+    import signal
+
+    from roko_tpu.serve import serve_forever
+
+    session = FakeSession(SERVE_CFG)
+    srv = make_server(session, SERVE_CFG.serve, port=0)
+    old = signal.getsignal(signal.SIGTERM)
+    timer = threading.Timer(
+        0.3, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    timer.start()
+    msgs = []
+    try:
+        serve_forever(srv, log=msgs.append)  # returns only if drained
+    finally:
+        timer.cancel()
+        signal.signal(signal.SIGTERM, old)
+    assert any("draining" in m for m in msgs)
+    assert any("drained clean" in m for m in msgs)
+    assert srv._draining.is_set()
+
+
+# -- client retries ----------------------------------------------------------
+
+
+def test_client_retries_honor_retry_after(monkeypatch):
+    """Satellite: the client sleeps through 503s with the server's
+    Retry-After as the backoff floor instead of failing on the first
+    backpressure response."""
+    client = PolishClient("http://test.invalid")
+    sleeps = []
+    client._sleep = sleeps.append
+    replies = [ServerBusy(2.0), ServerBusy(2.0), b'{"windows": 1}']
+
+    def fake_request(path, payload=None):
+        r = replies.pop(0)
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+    monkeypatch.setattr(client, "_request", fake_request)
+    out = client._post_with_retries({}, retries=3)
+    assert out == {"windows": 1}
+    assert len(sleeps) == 2
+    assert all(s >= 2.0 for s in sleeps)  # server floor honoured
+    assert all(s <= 2.0 * (1 + client.retry_policy.jitter) + 1e-9
+               for s in sleeps)  # bounded, not unbounded growth
+
+
+def test_client_retry_budget_is_bounded(monkeypatch):
+    client = PolishClient("http://test.invalid")
+    client._sleep = lambda s: None
+    calls = []
+
+    def always_busy(path, payload=None):
+        calls.append(1)
+        raise ServerBusy(0.01)
+
+    monkeypatch.setattr(client, "_request", always_busy)
+    with pytest.raises(ServerBusy):
+        client._post_with_retries({}, retries=2)
+    assert len(calls) == 3  # initial + 2 retries, then give up
+
+
+# -- config / CLI ------------------------------------------------------------
+
+
+def test_resilience_config_cli_layering():
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args([
+        "serve", "ckpt/", "--predict-deadline", "30",
+        "--hang-fallback", "cpu", "--breaker-failures", "7",
+        "--breaker-reset-s", "3", "--drain-deadline", "9",
+    ])
+    r = _build_config(args).resilience
+    assert r.predict_deadline_s == 30.0
+    assert r.hang_fallback == "cpu"
+    assert r.breaker_failures == 7
+    assert r.breaker_reset_s == 3.0
+    assert r.drain_deadline_s == 9.0
+    # defaults survive when flags are absent, on every subcommand
+    args = build_parser().parse_args(["polish", "r.fa", "x.bam", "m", "o.fa"])
+    assert _build_config(args).resilience == ResilienceConfig()
+    assert args.resume is False
+    args = build_parser().parse_args(
+        ["polish", "r.fa", "x.bam", "m", "o.fa", "--resume"]
+    )
+    assert args.resume is True
+
+
+def test_resilience_config_json_round_trip():
+    cfg = RokoConfig(resilience=ResilienceConfig(
+        predict_deadline_s=11.0, hang_fallback="cpu",
+        breaker_failures=2, breaker_reset_s=1.5, drain_deadline_s=4.0,
+    ))
+    assert RokoConfig.from_json(cfg.to_json()).resilience == cfg.resilience

@@ -469,8 +469,10 @@ def test_http_backpressure_maps_to_503(session):
         while batcher._q.qsize() == 0 and deadline:
             deadline -= 1
             threading.Event().wait(0.05)
+        # retries=0 surfaces the first busy reply (the default retries
+        # through it — see test_client_retries_honor_retry_after)
         with pytest.raises(ServerBusy) as exc:
-            client.polish(draft, positions, x)
+            client.polish(draft, positions, x, retries=0)
         assert exc.value.retry_after_s == CFG.serve.retry_after_s
         assert metrics.counters["rejected"] == 1
         # drain: start the worker, the occupying request completes

@@ -10,6 +10,10 @@ usable for compiles, 1 = not.
 The canary matters: r5 observed a failure mode where ``jax.devices()``
 answers but the first XLA compile never returns; a devices-only probe
 would call that chip healthy and a full bench budget would burn on it.
+
+This tool is a thin shell over the shared watchdog/probe subsystem
+(``roko_tpu.resilience.probe`` — the same implementation the bench
+orchestration uses); it owns no deadline logic of its own.
 """
 
 from __future__ import annotations
@@ -26,9 +30,9 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=240.0)
     args = ap.parse_args()
 
-    from roko_tpu.benchmark import _probe_backend
+    from roko_tpu.resilience import probe_backend
 
-    ok, why, platform = _probe_backend(
+    ok, why, platform = probe_backend(
         args.timeout, lambda m: print(m, file=sys.stderr, flush=True)
     )
     if ok:
