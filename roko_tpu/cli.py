@@ -45,12 +45,44 @@ def _model_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--mlp-ratio", type=int, default=None)
 
 
+def _compile_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--compile-cache", default=None, metavar="DIR",
+        help="persistent XLA compilation cache directory (default "
+        "~/.cache/roko-tpu/xla-cache; env ROKO_COMPILE_CACHE overrides, "
+        "and ROKO_COMPILE_CACHE=off disables)",
+    )
+    p.add_argument(
+        "--no-compile-cache", action="store_true", default=None,
+        help="disable the persistent compilation cache (every start "
+        "pays the full XLA compile again)",
+    )
+    p.add_argument(
+        "--cache-max-mb", type=int, default=None,
+        help="compile cache LRU size budget in MiB (default 1024; "
+        "0 = unbounded)",
+    )
+    p.add_argument(
+        "--bundle", default=None, metavar="DIR",
+        help="AOT executable bundle (written by `roko-tpu compile`) to "
+        "load pre-compiled predict executables from; a digest mismatch "
+        "(model/geometry/mesh/backend/jax version) is refused loudly",
+    )
+
+
 def _resilience_args(p: argparse.ArgumentParser, serve: bool = False) -> None:
     p.add_argument(
         "--predict-deadline", type=float, default=None,
         help="watchdog: seconds one device compile/predict call may take "
         "before the run dumps thread stacks and aborts (or falls over, "
         "see --hang-fallback); 0 disables (default 600)",
+    )
+    p.add_argument(
+        "--compile-deadline", type=float, default=None,
+        help="watchdog: seconds the FIRST dispatch of each batch shape "
+        "(which may include its XLA compile) may take — a cold cache is "
+        "legitimately slow and must not masquerade as a device hang; "
+        "0 disables (default 1800)",
     )
     p.add_argument(
         "--hang-fallback", choices=("none", "cpu"), default=None,
@@ -158,13 +190,21 @@ def _build_config(args: argparse.Namespace):
     resilience = over(
         base.resilience,
         predict_deadline_s="predict_deadline", hang_fallback="hang_fallback",
+        compile_deadline_s="compile_deadline",
         breaker_failures="breaker_failures", breaker_reset_s="breaker_reset_s",
         drain_deadline_s="drain_deadline",
     )
+    compile_cfg = over(
+        base.compile,
+        cache_dir="compile_cache", cache_max_mb="cache_max_mb",
+        bundle_dir="bundle",
+    )
+    if getattr(args, "no_compile_cache", None):
+        compile_cfg = dataclasses.replace(compile_cfg, enabled=False)
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, mesh=mesh, serve=serve,
-        pipeline=pipeline, resilience=resilience,
+        pipeline=pipeline, resilience=resilience, compile=compile_cfg,
     )
 
 
@@ -282,6 +322,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--e2e-draft", str(args.e2e_draft)]
     if args.pipeline_draft is not None:
         argv += ["--pipeline-draft", str(args.pipeline_draft)]
+    if args.coldstart_ladder is not None:
+        argv += ["--coldstart-ladder", args.coldstart_ladder]
     if args.in_process:
         argv.append("--in-process")
     bench_main(argv)
@@ -385,20 +427,127 @@ def _ladder_type(text: str):
     return rungs
 
 
+def cmd_compile(args: argparse.Namespace) -> int:
+    """Pre-compile the serve/polish predict ladder into an AOT bundle
+    (roko_tpu/compile, docs/SERVING.md "Cold start & compile cache"):
+    lowers the exact predict program for every ladder rung, runs XLA
+    once, and serializes the executables so a later ``serve --bundle``
+    / ``polish --bundle`` start deserializes instead of compiling. No
+    checkpoint needed — the compiled program depends only on the config.
+
+    After export the bundle is VERIFIED in a fresh subprocess (skip
+    with ``--no-verify``): each rung is deserialized and run on a zero
+    batch. A same-process load cannot catch a stub bundle — the
+    exporting process still has every compiled symbol registered — and
+    a stub bundle fails only at the next serve start."""
+    import os
+    import subprocess
+    import tempfile
+
+    from roko_tpu.compile import BUNDLE_MANIFEST, export_bundle
+
+    cfg = _build_config(args)
+    rungs = set(args.ladder or cfg.serve.ladder)
+    if args.b:
+        rungs.add(args.b)  # batch-CLI runs dispatch at --b too
+    manifest = export_bundle(args.out, cfg, ladder=sorted(rungs))
+    print(
+        f"compile: wrote bundle {args.out} "
+        f"(rungs {manifest['rungs']}, digest {manifest['digest'][:12]})"
+    )
+    if not args.no_verify:
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False
+        ) as f:
+            f.write(cfg.to_json())
+            cfg_path = f.name
+        budget = cfg.resilience.compile_deadline_s or None
+        try:
+            env = dict(os.environ, ROKO_COMPILE_CACHE="off")
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import sys; from roko_tpu.compile.bundle import "
+                    "verify_main; verify_main(sys.argv[1], sys.argv[2])",
+                    args.out,
+                    cfg_path,
+                ],
+                capture_output=True,
+                text=True,
+                env=env,
+                timeout=budget,
+            )
+            failure = r.stderr or r.stdout if r.returncode != 0 else None
+        except subprocess.TimeoutExpired:
+            failure = f"verification timed out after {budget:.0f}s"
+        finally:
+            os.unlink(cfg_path)
+        if failure is not None:
+            print(
+                "compile: bundle FAILED fresh-process verification — "
+                "refusing to leave it loadable:\n" + failure,
+                file=sys.stderr,
+            )
+            os.unlink(os.path.join(args.out, BUNDLE_MANIFEST))
+            return 1
+        print(f"compile: {r.stdout.strip()} (fresh process)")
+    return 0
+
+
 def cmd_serve(args: argparse.Namespace) -> int:
     """Long-lived polishing service (roko_tpu/serve, docs/SERVING.md):
-    load params once, pre-compile the padded-batch ladder, then serve
-    ``POST /polish`` with dynamic micro-batching until interrupted."""
+    load params once, bind the socket immediately, warm the predict
+    ladder on a worker thread (AOT bundle, else parallel compile through
+    the persistent cache), then serve ``POST /polish`` with dynamic
+    micro-batching until interrupted. While warming, ``/healthz`` says
+    ``"warming"`` and ``/polish`` sheds with 503+Retry-After — the
+    socket is never dark, and the not-ready window is observable."""
+    import threading
+    import time
+
+    from roko_tpu.compile import enable_persistent_cache
     from roko_tpu.serve import PolishSession, make_server, serve_forever
 
     cfg = _build_config(args)
+    cache_dir = enable_persistent_cache(cfg.compile)
+    if cache_dir:
+        print(f"serve: persistent compile cache at {cache_dir}")
     params = _load_model_params(args.model, cfg)
     session = PolishSession(params, cfg)
-    print(f"serve: warming predict ladder {session.ladder} ...")
-    compiled = session.warmup()
-    print(f"serve: {compiled} executables compiled; accepting requests")
-    server = make_server(session, cfg.serve)
+    server = make_server(session, cfg.serve, warming=True)
+    print(
+        f"serve: warming predict ladder {session.ladder} "
+        "(healthz=warming; /polish sheds until ready) ..."
+    )
+    warm_error: list = []
+
+    def _warm() -> None:
+        try:
+            t0 = time.perf_counter()
+            compiled = session.warmup(log=print)
+            dt = time.perf_counter() - t0
+            server.metrics.warmup_seconds = dt  # type: ignore[attr-defined]
+            server._warming.clear()  # type: ignore[attr-defined]
+            print(
+                f"serve: {compiled} executables ready in {dt:.1f}s "
+                f"({session.warmup_report.mode}); accepting requests"
+            )
+        except BaseException as e:
+            # a half-warm service must die loudly, not sit at 503
+            # forever: record, stop the accept loop, re-raise below
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            warm_error.append(e)
+            server.shutdown()
+
+    threading.Thread(
+        target=_warm, name="roko-serve-warmup", daemon=True
+    ).start()
     serve_forever(server)
+    if warm_error:
+        raise SystemExit(f"serve: warmup failed: {warm_error[0]}")
     return 0
 
 
@@ -569,6 +718,7 @@ def build_parser() -> argparse.ArgumentParser:
     _model_args(p)
     _mesh_args(p)
     _window_args(p)
+    _compile_args(p)
     p.set_defaults(fn=cmd_inference)
 
     p = sub.add_parser("convert", help="torch .pth -> native checkpoint")
@@ -577,6 +727,35 @@ def build_parser() -> argparse.ArgumentParser:
     _config_arg(p)
     _model_args(p)
     p.set_defaults(fn=cmd_convert)
+
+    p = sub.add_parser(
+        "compile",
+        help="pre-compile the predict ladder into an AOT executable "
+        "bundle (load with serve/polish/inference --bundle)",
+    )
+    p.add_argument("out", help="bundle output directory")
+    p.add_argument(
+        "--ladder", type=_ladder_type, default=None,
+        help="comma-separated batch sizes to pre-compile (default: the "
+        "serve ladder 32,128,512; each must divide by the dp mesh axis)",
+    )
+    p.add_argument(
+        "--b", type=int, default=None,
+        help="also pre-compile this batch size (the inference/polish "
+        "steady-state dispatch when it is not already a ladder rung)",
+    )
+    p.add_argument(
+        "--no-verify", action="store_true",
+        help="skip the fresh-subprocess load+run check of the exported "
+        "bundle (the check catches stub bundles a same-process load "
+        "cannot)",
+    )
+    _config_arg(p)
+    _model_args(p)
+    _mesh_args(p)
+    _window_args(p)
+    _compile_args(p)
+    p.set_defaults(fn=cmd_compile)
 
     p = sub.add_parser("bench", help="print the benchmark JSON line")
     p.add_argument("--train", action="store_true", help="also time training steps")
@@ -599,6 +778,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--pipeline-draft", type=int, default=None,
         help="staged-vs-streaming pipeline suite draft length "
         "(0 disables; default 500 kb on TPU, 60 kb elsewhere)",
+    )
+    p.add_argument(
+        "--coldstart-ladder", default=None,
+        help="coldstart suite ladder (cold vs warm compile cache vs AOT "
+        "bundle time-to-first-prediction), e.g. 32,128; 0 disables",
     )
     p.add_argument(
         "--in-process",
@@ -670,6 +854,7 @@ def build_parser() -> argparse.ArgumentParser:
     _mesh_args(p)
     _window_args(p)
     _resilience_args(p)
+    _compile_args(p)
     p.set_defaults(fn=cmd_polish)
 
     p = sub.add_parser(
@@ -699,6 +884,7 @@ def build_parser() -> argparse.ArgumentParser:
     _mesh_args(p)
     _window_args(p)
     _resilience_args(p, serve=True)
+    _compile_args(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

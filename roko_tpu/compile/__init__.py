@@ -1,0 +1,60 @@
+"""Compiled-executable lifecycle (docs/SERVING.md "Cold start & compile
+cache"): the cold-start elimination subsystem.
+
+Every ``roko-tpu serve`` / ``polish`` / ``inference`` start used to pay
+the full XLA compile of the predict step once per ladder rung, serially,
+from scratch — minutes of dead chip time before the first base is
+polished, recurring on every crash-resume, CPU fail-over, and bench
+child. Three cooperating tiers kill it:
+
+1. **Persistent compilation cache** (:mod:`cache`) — JAX's disk cache,
+   on by default, so recompiling an identical (program, backend,
+   jax-version) pays a disk read, not an XLA run. Opt out with
+   ``ROKO_COMPILE_CACHE=off`` or ``--no-compile-cache``.
+2. **AOT executable bundles** (:mod:`bundle`) — ``roko-tpu compile``
+   pre-lowers and serializes the predict step for every ladder rung into
+   a versioned bundle keyed by a digest of (ModelConfig incl. window
+   geometry, mesh, backend, device_kind, jax version); the serving
+   session and both polish paths load a matching bundle instead of
+   compiling, and refuse a stale one loudly (:class:`BundleMismatch`).
+3. **Parallel ladder warmup** (:mod:`warmup`) — when no bundle exists,
+   ladder rungs compile concurrently (XLA compilation releases the GIL)
+   instead of the old serial loop.
+"""
+
+from roko_tpu.compile.cache import (
+    cache_counters,
+    cache_entry_count,
+    cache_total_bytes,
+    enable_persistent_cache,
+    resolve_cache_dir,
+)
+from roko_tpu.compile.bundle import (
+    BUNDLE_MANIFEST,
+    BundleMismatch,
+    bundle_digest,
+    bundle_identity,
+    export_bundle,
+    load_bundle,
+    read_manifest,
+    wrap_predict,
+)
+from roko_tpu.compile.warmup import WarmupReport, warmup_ladder
+
+__all__ = [
+    "BUNDLE_MANIFEST",
+    "BundleMismatch",
+    "WarmupReport",
+    "bundle_digest",
+    "bundle_identity",
+    "cache_counters",
+    "cache_entry_count",
+    "cache_total_bytes",
+    "enable_persistent_cache",
+    "export_bundle",
+    "load_bundle",
+    "read_manifest",
+    "resolve_cache_dir",
+    "warmup_ladder",
+    "wrap_predict",
+]

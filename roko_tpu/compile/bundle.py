@@ -1,0 +1,336 @@
+"""AOT executable bundles: pre-compiled predict ladders on disk.
+
+``roko-tpu compile`` lowers the predict step (``infer.make_predict_step``
+— the exact program serve/polish/inference run) for every ladder rung
+with **abstract** inputs (``jax.eval_shape`` over ``model.init``, so no
+checkpoint is needed — the compiled program depends only on shapes), runs
+the full XLA pipeline once, and serializes each executable
+(``jax.experimental.serialize_executable``) into a directory::
+
+    <bundle>/manifest.json      identity + digest + rung inventory
+    <bundle>/rung_00032.aotx    pickled (serialized_exec, in_tree, out_tree)
+    <bundle>/rung_00128.aotx    ...
+
+A loading process (``PolishSession.warmup``, ``pipeline/stream.py``,
+``infer.run_inference``) deserializes the executables instead of
+compiling — cold-start cost collapses to a disk read — but ONLY when the
+bundle's identity digest matches the running process exactly. The digest
+covers everything that changes the compiled program or would make its
+outputs wrong: the full ModelConfig (window geometry lives there), the
+mesh shape (dp/tp/sp), backend platform, device kind, and jax version.
+A mismatch raises :class:`BundleMismatch` naming the differing fields —
+loudly refused, never silently recompiled into wrong results (the same
+refuse-don't-guess contract as the resume journal's identity check,
+``resilience/journal.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import pickle
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+BUNDLE_MANIFEST = "manifest.json"
+BUNDLE_VERSION = 1
+
+Log = Callable[[str], None]
+
+
+class BundleMismatch(RuntimeError):
+    """An AOT bundle does not match the running process. Carrying on
+    would run a program compiled for a DIFFERENT model/geometry/backend
+    — wrong results, not just wrong speed — so loading refuses."""
+
+
+def _canonical(obj: Any) -> Any:
+    """JSON-normalize (tuples -> lists, etc.) so identity comparison and
+    digesting are stable across load/dump round trips."""
+    return json.loads(json.dumps(obj, sort_keys=True))
+
+
+def bundle_identity(cfg, mesh=None, *, backend: Optional[str] = None) -> Dict[str, Any]:
+    """Everything the compiled predict program (and the correctness of
+    its outputs) depends on. ``mesh`` defaults to the config's mesh over
+    the live devices."""
+    from roko_tpu.parallel.mesh import make_mesh
+
+    mesh = mesh or make_mesh(cfg.mesh)
+    dev = np.asarray(mesh.devices).flat[0]
+    return _canonical(
+        {
+            "bundle_version": BUNDLE_VERSION,
+            "jax_version": jax.__version__,
+            "backend": backend or dev.platform,
+            "device_kind": dev.device_kind,
+            "mesh": dict(mesh.shape),
+            "model": dataclasses.asdict(cfg.model),
+        }
+    )
+
+
+def bundle_digest(identity: Dict[str, Any]) -> str:
+    """sha256 over the canonical identity JSON."""
+    blob = json.dumps(_canonical(identity), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _identity_diff(ours: Any, theirs: Any, prefix: str = "") -> list:
+    """Human-actionable field-level diff between two identities."""
+    if isinstance(ours, dict) and isinstance(theirs, dict):
+        out = []
+        for key in sorted(set(ours) | set(theirs)):
+            out += _identity_diff(
+                ours.get(key, "<absent>"),
+                theirs.get(key, "<absent>"),
+                f"{prefix}{key}.",
+            )
+        return out
+    if ours != theirs:
+        return [f"{prefix[:-1]}: bundle={theirs!r} run={ours!r}"]
+    return []
+
+
+def _rung_file(rung: int) -> str:
+    return f"rung_{rung:05d}.aotx"
+
+
+def _abstract_predict_args(cfg, mesh):
+    """Abstract (params, x) for lowering one predict rung — no real
+    params needed: ``jax.eval_shape`` walks ``model.init`` without
+    computing, so ``roko-tpu compile`` works straight from a config."""
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.parallel.mesh import data_sharding, replicated_sharding
+
+    model = RokoModel(cfg.model)
+    repl = replicated_sharding(mesh)
+    data = data_sharding(mesh)
+    shapes = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    params_abs = jax.tree.map(
+        lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=repl), shapes
+    )
+
+    def x_abs(rung: int):
+        return jax.ShapeDtypeStruct(
+            (rung, cfg.model.window_rows, cfg.model.window_cols),
+            np.uint8,
+            sharding=data,
+        )
+
+    return model, params_abs, x_abs
+
+
+def export_bundle(
+    out_dir: str,
+    cfg,
+    *,
+    mesh=None,
+    ladder: Optional[Sequence[int]] = None,
+    log: Log = print,
+) -> Dict[str, Any]:
+    """Compile every ladder rung of the predict step and serialize the
+    executables into ``out_dir``; returns the manifest. Files are
+    written atomically and the manifest last, so a crashed export never
+    looks loadable.
+
+    The persistent compilation cache is DISABLED for the export's own
+    compiles: serializing an executable that XLA deserialized from the
+    cache writes a stub missing its compiled symbols — on a warm-cache
+    machine (any box that has served this config before) the bundle
+    would look fine and then fail every load with an INTERNAL
+    "Symbols not found". Export always runs real XLA compiles;
+    ``roko-tpu compile`` verifies the result in a fresh process."""
+    import jax as _jax
+    from jax.experimental import serialize_executable
+
+    from roko_tpu.infer import make_predict_step
+    from roko_tpu.parallel.mesh import AXIS_DP, make_mesh
+
+    mesh = mesh or make_mesh(cfg.mesh)
+    rungs = tuple(sorted(set(ladder if ladder is not None else cfg.serve.ladder)))
+    if not rungs:
+        raise ValueError("bundle ladder must name at least one batch size")
+    dp = mesh.shape[AXIS_DP]
+    bad = [r for r in rungs if r <= 0 or r % dp]
+    if bad:
+        raise ValueError(f"ladder rungs {bad} not positive multiples of dp={dp}")
+
+    model, params_abs, x_abs = _abstract_predict_args(cfg, mesh)
+    step = make_predict_step(model, mesh)
+    identity = bundle_identity(cfg, mesh)
+    os.makedirs(out_dir, exist_ok=True)
+
+    files: Dict[str, str] = {}
+    t0 = time.perf_counter()
+    cache_was_on = bool(_jax.config.jax_enable_compilation_cache)
+    if cache_was_on:
+        _jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        for rung in rungs:
+            t_r = time.perf_counter()
+            compiled = step.lower(params_abs, x_abs(rung)).compile()
+            ser, in_tree, out_tree = serialize_executable.serialize(compiled)
+            name = _rung_file(rung)
+            tmp = os.path.join(out_dir, name + ".tmp")
+            with open(tmp, "wb") as f:
+                pickle.dump((ser, in_tree, out_tree), f)
+            os.replace(tmp, os.path.join(out_dir, name))
+            files[str(rung)] = name
+            log(
+                f"compile: rung {rung} lowered+compiled+serialized in "
+                f"{time.perf_counter() - t_r:.1f}s ({name})"
+            )
+    finally:
+        if cache_was_on:
+            _jax.config.update("jax_enable_compilation_cache", True)
+
+    manifest = {
+        "bundle_version": BUNDLE_VERSION,
+        "identity": identity,
+        "digest": bundle_digest(identity),
+        "rungs": list(rungs),
+        "files": files,
+        "created_unix": int(time.time()),
+    }
+    tmp = os.path.join(out_dir, BUNDLE_MANIFEST + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, sort_keys=True)
+    os.replace(tmp, os.path.join(out_dir, BUNDLE_MANIFEST))
+    log(
+        f"compile: bundle {out_dir} ready — {len(rungs)} rung(s) in "
+        f"{time.perf_counter() - t0:.1f}s, digest {manifest['digest'][:12]}"
+    )
+    return manifest
+
+
+def read_manifest(bundle_dir: str) -> Dict[str, Any]:
+    """The bundle's manifest dict (``FileNotFoundError`` with an
+    actionable message when the directory is not a bundle)."""
+    path = os.path.join(bundle_dir, BUNDLE_MANIFEST)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        raise FileNotFoundError(
+            f"{bundle_dir!r} is not an AOT bundle (no {BUNDLE_MANIFEST}); "
+            "create one with `roko-tpu compile <out_dir>`"
+        ) from None
+
+
+def load_bundle(
+    bundle_dir: str,
+    cfg,
+    *,
+    mesh=None,
+    rungs: Optional[Sequence[int]] = None,
+    require_all: bool = False,
+    log: Log = print,
+) -> Dict[int, Callable]:
+    """Deserialize the bundle's executables: ``{rung: compiled}``, each
+    callable as ``compiled(params, x)`` exactly like the jitted predict
+    step (same program, same shardings — outputs are bit-identical).
+
+    Refuses loudly (:class:`BundleMismatch`) when the bundle's identity
+    digest differs from this process's, or — with ``require_all`` — when
+    a requested rung is missing. ``rungs=None`` loads everything the
+    bundle has; otherwise only the intersection is loaded (the batch
+    paths fall back to jit for one-off tail shapes).
+    """
+    from jax.experimental import serialize_executable
+
+    from roko_tpu.parallel.mesh import make_mesh
+
+    mesh = mesh or make_mesh(cfg.mesh)
+    manifest = read_manifest(bundle_dir)
+    theirs = manifest.get("identity", {})
+    ours = bundle_identity(cfg, mesh)
+    if bundle_digest(ours) != manifest.get("digest"):
+        diff = _identity_diff(ours, theirs)
+        raise BundleMismatch(
+            f"AOT bundle {bundle_dir!r} was built for a different "
+            "program; refusing to load it (a mismatched executable would "
+            "produce wrong results, not just wrong speed). Differing "
+            "fields:\n  " + "\n  ".join(diff or ["<digest mismatch only>"])
+            + "\nRe-export with `roko-tpu compile` under the current "
+            "config/backend, or drop --bundle to compile normally."
+        )
+
+    have = {int(r) for r in manifest.get("rungs", [])}
+    want = set(int(r) for r in rungs) if rungs is not None else set(have)
+    missing = sorted(want - have)
+    if missing and require_all:
+        raise BundleMismatch(
+            f"AOT bundle {bundle_dir!r} has rungs {sorted(have)} but this "
+            f"ladder needs {sorted(want)} (missing {missing}); re-export "
+            f"with `roko-tpu compile --ladder "
+            f"{','.join(str(r) for r in sorted(want))}`"
+        )
+
+    execs: Dict[int, Callable] = {}
+    t0 = time.perf_counter()
+    # rungs deserialize SERIALLY on purpose: unlike compilation,
+    # deserialize_and_load races the backend's executable-symbol
+    # registry when called concurrently (CPU backend: intermittent
+    # "Symbols not found" INTERNAL errors) — warmup_ladder's
+    # concurrency is for compiles only
+    for rung in sorted(want & have):
+        path = os.path.join(bundle_dir, manifest["files"][str(rung)])
+        with open(path, "rb") as f:
+            ser, in_tree, out_tree = pickle.load(f)
+        execs[rung] = serialize_executable.deserialize_and_load(
+            ser, in_tree, out_tree
+        )
+    if execs:
+        log(
+            f"AOT bundle: loaded {len(execs)} executable(s) "
+            f"{sorted(execs)} from {bundle_dir} in "
+            f"{time.perf_counter() - t0:.2f}s (digest "
+            f"{manifest['digest'][:12]})"
+        )
+    return execs
+
+
+def verify_main(bundle_dir: str, cfg_json_path: str) -> None:
+    """Child half of the ``roko-tpu compile`` post-export check: in THIS
+    (fresh) process, deserialize every rung and run it on a zero batch.
+    A same-process check cannot catch a stub bundle — deserialization
+    finds the exporting process's still-registered symbols — so the CLI
+    runs this in a subprocess with the compile cache off."""
+    import jax as _jax
+
+    from roko_tpu.config import RokoConfig
+    from roko_tpu.models.model import RokoModel
+
+    with open(cfg_json_path) as f:
+        cfg = RokoConfig.from_json(f.read())
+    manifest = read_manifest(bundle_dir)
+    rungs = [int(r) for r in manifest["rungs"]]
+    execs = load_bundle(
+        bundle_dir, cfg, rungs=rungs, require_all=True, log=lambda m: None
+    )
+    params = RokoModel(cfg.model).init(_jax.random.PRNGKey(0))
+    shape = (cfg.model.window_rows, cfg.model.window_cols)
+    for rung in rungs:
+        out = execs[rung](params, np.zeros((rung,) + shape, np.uint8))
+        _jax.block_until_ready(out)
+    print(f"verified {len(rungs)} rung(s): {rungs}")
+
+
+def wrap_predict(step: Callable, execs: Dict[int, Callable]) -> Callable:
+    """Dispatch-by-batch-rows: a bundled executable when the padded
+    batch size has one, the jitted ``step`` otherwise (one-off tail
+    shapes). Signature-compatible with ``make_predict_step``'s jit."""
+    if not execs:
+        return step
+
+    def predict(params, x):
+        fn = execs.get(int(x.shape[0]))
+        return fn(params, x) if fn is not None else step(params, x)
+
+    return predict

@@ -1,0 +1,217 @@
+"""Persistent XLA compilation cache management.
+
+JAX ships a disk-backed compilation cache (the machinery t5x-scale
+training stacks lean on — PAPERS.md: compile caching as a prerequisite
+for iterating at scale): a compiled executable is keyed by a hash of the
+(HLO module, compile options, backend, jax version) and written to a
+directory; any later compile of an identical program — another process,
+a crash-resume, a ``--hang-fallback cpu`` fail-over child, the next
+serve start — is a disk read instead of an XLA run.
+
+This module turns it on **by default** and makes it operable:
+
+- :func:`enable_persistent_cache` — idempotent process-wide enable,
+  layered resolution: ``ROKO_COMPILE_CACHE`` env (a path, or
+  ``off``/``0``/``none`` to disable) > ``CompileConfig.cache_dir`` >
+  the default ``~/.cache/roko-tpu/xla-cache``. Size-bounded via JAX's
+  built-in LRU eviction (``CompileConfig.cache_max_mb``).
+- :func:`cache_counters` — process-wide persistent-cache hit/miss
+  counts fed by JAX's monitoring events; surfaced as
+  ``roko_compile_cache_hits``/``_misses`` on serve ``/metrics`` and in
+  the bench coldstart suite.
+- :func:`cache_entry_count` / :func:`cache_total_bytes` — cheap disk
+  inventory for ``tools/cache_probe.py`` and the healthz payload.
+
+The cache stores *device code*, so entries are backend- and
+jax-version-specific by construction — a stale entry can mis-hit only if
+XLA's own cache key breaks, which is exactly the contract every
+production JAX stack already relies on.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional, Tuple
+
+Log = Callable[[str], None]
+
+#: env var: a cache directory path, or one of :data:`_OFF_VALUES` to
+#: disable the persistent cache entirely (the documented opt-out)
+ENV_CACHE = "ROKO_COMPILE_CACHE"
+
+_OFF_VALUES = frozenset({"", "0", "off", "none", "disable", "disabled"})
+
+_DEFAULT_DIR = os.path.join("~", ".cache", "roko-tpu", "xla-cache")
+
+_lock = threading.Lock()
+_active_dir: Optional[str] = None
+_configured = False  # enable_persistent_cache ran (even if it disabled)
+
+_hits = 0
+_requests = 0
+_listener_registered = False
+
+# jax (0.4.x) emits no explicit miss event: every compile that consults
+# the persistent cache records a request, and only the successful reads
+# record a hit — misses are the difference
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_REQUEST_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+
+def _on_event(event: str, **_kw) -> None:
+    global _hits, _requests
+    if event == _HIT_EVENT:
+        _hits += 1
+    elif event == _REQUEST_EVENT:
+        _requests += 1
+
+
+def _register_listener() -> None:
+    global _listener_registered
+    if _listener_registered:
+        return
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        _listener_registered = True
+    except Exception:  # pragma: no cover - jax internals drift
+        pass  # counters stay zero; metrics render 0, nothing breaks
+
+
+def cache_counters() -> Tuple[int, int]:
+    """(hits, misses) of the persistent compilation cache in this
+    process, across every backend/program. Monotonic; snapshot before
+    and after a phase to attribute counts to it."""
+    return _hits, max(0, _requests - _hits)
+
+
+def resolve_cache_dir(ccfg=None) -> Optional[str]:
+    """The cache directory the layered config resolves to, or ``None``
+    when the persistent cache is disabled. Resolution order:
+    ``ROKO_COMPILE_CACHE`` env > ``CompileConfig`` > built-in default."""
+    env = os.environ.get(ENV_CACHE)
+    if env is not None:
+        if env.strip().lower() in _OFF_VALUES:
+            return None
+        return os.path.expanduser(env)
+    if ccfg is not None and not ccfg.enabled:
+        return None
+    if ccfg is not None and ccfg.cache_dir:
+        return os.path.expanduser(ccfg.cache_dir)
+    return os.path.expanduser(_DEFAULT_DIR)
+
+
+def enable_persistent_cache(ccfg=None, *, log: Optional[Log] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache process-wide (idempotent;
+    the first caller's directory wins — one process, one cache). Returns
+    the active cache directory, or ``None`` when disabled.
+
+    ``ccfg`` is a :class:`roko_tpu.config.CompileConfig` (or ``None`` for
+    its defaults). Every runtime entry point — serve, both polish paths,
+    ``run_inference``, the bench, ``tools/chip_probe.py`` — calls this
+    before its first compile, so the cache is on unless explicitly
+    opted out.
+    """
+    global _active_dir, _configured
+    with _lock:
+        if _configured:
+            want = resolve_cache_dir(ccfg)
+            if log is not None and want != _active_dir:
+                log(
+                    f"compile cache already configured at {_active_dir!r}; "
+                    f"ignoring later request for {want!r}"
+                )
+            return _active_dir
+        _configured = True
+        d = resolve_cache_dir(ccfg)
+        if d is None:
+            _active_dir = None
+            return None
+
+        import jax
+
+        os.makedirs(d, exist_ok=True)
+        max_mb = ccfg.cache_max_mb if ccfg is not None else 1024
+        min_compile_s = ccfg.min_compile_time_s if ccfg is not None else 0.0
+        jax.config.update("jax_enable_compilation_cache", True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # jax initializes its cache lazily at the FIRST compile and then
+        # never re-reads the directory config; if anything compiled
+        # before this call (params restore, a probe canary), that
+        # initialization latched "no dir" and every later read/write
+        # silently no-ops. Reset so the next compile re-initializes
+        # against the directory configured above.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # pragma: no cover - jax internals drift
+            pass
+        # cache even fast compiles by default: a serve ladder is many
+        # small programs and the cold start pays all of them
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_s)
+        )
+        # LRU eviction against the size budget (jax maintains -atime
+        # files per entry); <= 0 = unbounded
+        jax.config.update(
+            "jax_compilation_cache_max_size",
+            int(max_mb) * 2**20 if max_mb and max_mb > 0 else -1,
+        )
+        _register_listener()
+        _active_dir = d
+        if log is not None:
+            log(f"persistent compile cache: {d}")
+        return d
+
+
+def active_cache_dir() -> Optional[str]:
+    """The directory :func:`enable_persistent_cache` activated (None =
+    not enabled / disabled)."""
+    return _active_dir
+
+
+def _entry_files(cache_dir: str):
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return
+    for name in names:
+        if name.endswith("-atime"):  # LRU bookkeeping, not an entry
+            continue
+        yield os.path.join(cache_dir, name)
+
+
+def cache_entry_count(cache_dir: Optional[str] = None) -> int:
+    """Number of cached executables on disk (0 for a missing dir)."""
+    d = cache_dir or _active_dir
+    if not d:
+        return 0
+    return sum(1 for _ in _entry_files(d))
+
+
+def cache_total_bytes(cache_dir: Optional[str] = None) -> int:
+    """Total bytes the cached executables occupy."""
+    d = cache_dir or _active_dir
+    if not d:
+        return 0
+    total = 0
+    for path in _entry_files(d):
+        try:
+            total += os.stat(path).st_size
+        except OSError:
+            continue
+    return total
+
+
+def _reset_for_tests() -> None:
+    """Forget the process-wide enable so a test can exercise resolution
+    again. Does NOT restore jax.config — tests that enable a real cache
+    point it at a tmpdir and leave it (harmless: later compiles just
+    keep caching there)."""
+    global _configured, _active_dir
+    with _lock:
+        _configured = False
+        _active_dir = None

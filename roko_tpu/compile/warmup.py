@@ -1,0 +1,109 @@
+"""Parallel ladder warmup: compile every rung concurrently.
+
+The old ``PolishSession.warmup`` compiled the ladder serially — rung
+after rung of dead chip time, because XLA compilation runs in native
+code and **releases the GIL**: N host cores can compile N rungs at once.
+This helper is the one shared implementation: callers hand it a
+``compile_rung(rung)`` callable (a zero-batch dispatch, an AOT-validate
+call, a ``lower().compile()`` — whatever makes that rung hot) and get
+back a :class:`WarmupReport` with wall/per-rung timings and the
+persistent-cache hit/miss delta, which serve surfaces as
+``roko_serve_warmup_seconds`` / ``roko_compile_cache_*`` metrics and the
+bench records in its coldstart suite.
+
+A rung failure (including a watchdog :class:`~roko_tpu.resilience.HangError`
+from a guarded ``compile_rung``) cancels the rest and re-raises — a
+half-warm service must fail its start loudly, not limp."""
+
+from __future__ import annotations
+
+import os
+import time
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Sequence
+
+from roko_tpu.compile.cache import cache_counters
+
+Log = Callable[[str], None]
+
+
+@dataclass
+class WarmupReport:
+    """What a ladder warmup cost and where the executables came from."""
+
+    seconds: float = 0.0
+    mode: str = "serial"  # "serial" | "parallel" | "aot"
+    per_rung_s: Dict[int, float] = field(default_factory=dict)
+    #: persistent-cache deltas across the warmup window
+    cache_hits: int = 0
+    cache_misses: int = 0
+
+    def as_dict(self) -> Dict[str, object]:
+        return {
+            "seconds": round(self.seconds, 3),
+            "mode": self.mode,
+            "per_rung_s": {
+                str(r): round(s, 3) for r, s in sorted(self.per_rung_s.items())
+            },
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+        }
+
+
+def warmup_ladder(
+    rungs: Sequence[int],
+    compile_rung: Callable[[int], object],
+    *,
+    parallel: bool = True,
+    max_workers: int = 0,
+    mode: Optional[str] = None,
+    log: Optional[Log] = None,
+) -> WarmupReport:
+    """Make every rung in ``rungs`` hot by calling ``compile_rung`` for
+    each — concurrently when ``parallel`` (and more than one rung), in
+    order otherwise. ``max_workers`` 0 = one per rung capped at the host
+    core count. Deadlines are the *caller's* job: ``compile_rung``
+    should already be guarded (the session routes through its watchdog
+    ``DeadlinePolicy``), so a hung compile raises here instead of
+    wedging the pool."""
+    rungs = list(rungs)
+    hits0, misses0 = cache_counters()
+    t0 = time.perf_counter()
+    report = WarmupReport(mode=mode or ("parallel" if parallel else "serial"))
+
+    def one(rung: int) -> None:
+        t_r = time.perf_counter()
+        compile_rung(rung)
+        report.per_rung_s[rung] = time.perf_counter() - t_r
+
+    if parallel and len(rungs) > 1:
+        workers = max_workers or min(len(rungs), os.cpu_count() or 1)
+        workers = max(1, min(workers, len(rungs)))
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="roko-warmup"
+        ) as pool:
+            futs = {pool.submit(one, r): r for r in rungs}
+            done, not_done = wait(futs, return_when=FIRST_EXCEPTION)
+            failed = [f for f in done if f.exception() is not None]
+            if failed:
+                for f in not_done:
+                    f.cancel()
+                raise failed[0].exception()
+    else:
+        if mode is None and len(rungs) <= 1:
+            report.mode = "serial"
+        for r in rungs:
+            one(r)
+
+    hits1, misses1 = cache_counters()
+    report.seconds = time.perf_counter() - t0
+    report.cache_hits = hits1 - hits0
+    report.cache_misses = misses1 - misses0
+    if log is not None:
+        log(
+            f"warmup: {len(rungs)} rung(s) ready in {report.seconds:.1f}s "
+            f"({report.mode}; cache hits={report.cache_hits} "
+            f"misses={report.cache_misses})"
+        )
+    return report

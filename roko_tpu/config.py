@@ -189,6 +189,35 @@ class PipelineConfig:
 
 
 @dataclass(frozen=True)
+class CompileConfig:
+    """Cold-start elimination (roko_tpu/compile; docs/SERVING.md
+    "Cold start & compile cache"): persistent XLA compilation cache,
+    AOT executable bundles, and parallel ladder warmup."""
+
+    #: persistent compilation cache on/off (the documented opt-out is
+    #: this flag, ``--no-compile-cache``, or ``ROKO_COMPILE_CACHE=off``;
+    #: the env var overrides everything here)
+    enabled: bool = True
+    #: cache directory; None = ``~/.cache/roko-tpu/xla-cache``
+    cache_dir: Optional[str] = None
+    #: LRU size budget for the cache dir in MiB (jax evicts least-
+    #: recently-used entries past it); <= 0 = unbounded
+    cache_max_mb: int = 1024
+    #: only cache compiles slower than this (0 = cache everything — a
+    #: serve ladder is many small programs and cold start pays them all)
+    min_compile_time_s: float = 0.0
+    #: AOT bundle directory (written by ``roko-tpu compile``) to load
+    #: executables from instead of compiling; a digest mismatch refuses
+    #: loudly. None = compile (through the persistent cache).
+    bundle_dir: Optional[str] = None
+    #: compile ladder rungs concurrently during warmup (XLA compilation
+    #: releases the GIL); False = the old serial loop
+    parallel_warmup: bool = True
+    #: warmup thread cap; 0 = min(len(ladder), host cores)
+    warmup_workers: int = 0
+
+
+@dataclass(frozen=True)
 class ResilienceConfig:
     """Failure-handling knobs shared by pipeline, serve, and bench
     (roko_tpu/resilience; docs/PIPELINE.md + docs/SERVING.md
@@ -199,6 +228,12 @@ class ResilienceConfig:
     #: forever (the r5 wedge signature: devices answer, the first XLA
     #: compile never returns). 0 disables the watchdog entirely.
     predict_deadline_s: float = 600.0
+    #: separate (much larger) deadline for the FIRST dispatch of each
+    #: padded batch shape — warmup and cold-cache compiles are
+    #: legitimately slow, and under the single predict budget a cold
+    #: XLA compile could masquerade as a device hang. 0 disables the
+    #: watchdog for first dispatches.
+    compile_deadline_s: float = 1800.0
     #: what a blown predict deadline does next: "none" propagates the
     #: HangError (the CLI exits nonzero), "cpu" recompiles the predict
     #: step on the host CPU and finishes the run there — degraded
@@ -225,6 +260,7 @@ class RokoConfig:
     serve: ServeConfig = field(default_factory=ServeConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    compile: CompileConfig = field(default_factory=CompileConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -244,6 +280,7 @@ class RokoConfig:
                                  for k, v in raw.get("serve", {}).items()}),
             pipeline=PipelineConfig(**raw.get("pipeline", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),
+            compile=CompileConfig(**raw.get("compile", {})),
         )
 
 

@@ -409,6 +409,14 @@ def run_inference(
     if batch_size % dp:
         raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
 
+    # cold-start tier (roko_tpu/compile): persistent compilation cache
+    # on by default, and a configured AOT bundle replaces the compile
+    # for every ladder-padded batch shape (digest-checked — a mismatch
+    # refuses loudly instead of polishing with the wrong program)
+    from roko_tpu.compile import load_bundle, wrap_predict
+    from roko_tpu.compile.cache import enable_persistent_cache
+
+    enable_persistent_cache(cfg.compile)
     model = RokoModel(cfg.model)
     params = jax.device_put(params, replicated_sharding(mesh))
     predict = make_predict_step(model, mesh)
@@ -429,6 +437,14 @@ def run_inference(
     # 1-window tail on a --b 2048 run stops paying 2047 rows of wasted
     # compute for one extra (one-off, never steady-state) compile
     rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
+    if cfg.compile.bundle_dir:
+        predict = wrap_predict(
+            predict,
+            load_bundle(
+                cfg.compile.bundle_dir, cfg, mesh=mesh, rungs=rungs,
+                log=log,
+            ),
+        )
 
     def place(item):
         names, positions, x, release = item

@@ -54,6 +54,8 @@ import numpy as np
 from jax.sharding import Mesh
 
 from roko_tpu import constants as C
+from roko_tpu.compile import load_bundle, wrap_predict
+from roko_tpu.compile.cache import enable_persistent_cache
 from roko_tpu.config import RokoConfig
 from roko_tpu.data.hdf5 import DataWriter
 from roko_tpu.features.pipeline import open_region_stream
@@ -66,7 +68,12 @@ from roko_tpu.infer import (
     rung_for,
     tail_rungs,
 )
-from roko_tpu.resilience import HangError, PolishJournal, call_with_deadline
+from roko_tpu.resilience import (
+    DeadlinePolicy,
+    HangError,
+    PolishJournal,
+    call_with_deadline,
+)
 from roko_tpu.resilience.watchdog import thread_stack
 from roko_tpu.models.model import RokoModel
 from roko_tpu.parallel.mesh import (
@@ -411,6 +418,13 @@ def run_streaming_polish(
     if batch_size % dp:
         raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
 
+    # cold-start tier (roko_tpu/compile): persistent compilation cache
+    # on by default — a crash-resume or fail-over rerun of this exact
+    # program is a disk hit, not an XLA run — and, when configured, the
+    # AOT bundle replaces the compile entirely (digest-checked; a
+    # mismatch refuses loudly rather than polishing with the wrong
+    # program)
+    enable_persistent_cache(cfg.compile)
     model = RokoModel(cfg.model)
     params_host = params  # kept host-side for the CPU hang fail-over
     params = jax.device_put(params, replicated_sharding(mesh))
@@ -419,8 +433,20 @@ def run_streaming_polish(
     # partial/tail batches pad to the serve ladder (plus batch_size), so
     # deadline flushes never hand the compiler a novel shape
     rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
+    if cfg.compile.bundle_dir:
+        predict = wrap_predict(
+            predict,
+            load_bundle(
+                cfg.compile.bundle_dir, cfg, mesh=mesh, rungs=rungs, log=log
+            ),
+        )
     timer = timer if timer is not None else StageTimer()
     rcfg = cfg.resilience
+    # split watchdog budgets per padded shape: first dispatch (compile)
+    # gets compile_deadline_s, steady state predict_deadline_s
+    deadlines = DeadlinePolicy(
+        rcfg.predict_deadline_s, rcfg.compile_deadline_s
+    )
 
     if resume and not out_path:
         raise ValueError(
@@ -580,16 +606,27 @@ def run_streaming_polish(
             if cpu_predict[0] is not None or dev is None:
                 fn = cpu_predict[0] or fail_over("predict-dispatch")
                 return "preds", fn(x_padded)
+            deadline_s, first = deadlines.deadline_for(int(dev.shape[0]))
             try:
                 fut = call_with_deadline(
                     lambda: predict(params, dev),
-                    rcfg.predict_deadline_s,
-                    stage="pipeline-predict-dispatch",
+                    deadline_s,
+                    stage=(
+                        "pipeline-predict-compile"
+                        if first
+                        else "pipeline-predict-dispatch"
+                    ),
                     log=log,
                 )
                 return "fut", fut
-            except HangError:
-                return "preds", fail_over("predict-dispatch")(x_padded)
+            except BaseException as e:
+                # a failed FIRST dispatch left no executable behind:
+                # re-arm the compile budget for any retry of this shape
+                if first:
+                    deadlines.forget(int(dev.shape[0]))
+                if isinstance(e, HangError):
+                    return "preds", fail_over("predict-dispatch")(x_padded)
+                raise
 
         def drain(entry) -> int:
             names, pos, kind, val, x_padded, n, comps = entry

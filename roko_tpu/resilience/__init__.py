@@ -30,6 +30,7 @@ from roko_tpu.resilience.journal import JournalMismatch, PolishJournal
 from roko_tpu.resilience.probe import probe_backend
 from roko_tpu.resilience.retry import RetryPolicy
 from roko_tpu.resilience.watchdog import (
+    DeadlinePolicy,
     HangError,
     call_with_deadline,
     dump_thread_stacks,
@@ -37,6 +38,7 @@ from roko_tpu.resilience.watchdog import (
 
 __all__ = [
     "CircuitBreaker",
+    "DeadlinePolicy",
     "HangError",
     "JournalMismatch",
     "PolishJournal",

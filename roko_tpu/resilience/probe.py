@@ -70,9 +70,25 @@ def spawn_logged(cmd, budget_s: float, **popen_kw) -> Tuple[Optional[int], str]:
     return rc, out
 
 
+# The canary also enables the persistent compilation cache (inline —
+# the child can't assume roko_tpu is importable from its cwd, so the
+# ROKO_COMPILE_CACHE resolution from roko_tpu/compile/cache.py is
+# mirrored here): probing a chip leaves its canary compile in the
+# cache, so the probe doubles as a free cache warm.
 _CANARY = (
+    "import os\n"
     "import jax\n"
     "import jax.numpy as jnp\n"
+    "_d = os.environ.get('ROKO_COMPILE_CACHE')\n"
+    "if _d is None:\n"
+    "    _d = os.path.join('~', '.cache', 'roko-tpu', 'xla-cache')\n"
+    "if _d.strip().lower() not in ('', '0', 'off', 'none', 'disable',"
+    " 'disabled'):\n"
+    "    _d = os.path.expanduser(_d)\n"
+    "    os.makedirs(_d, exist_ok=True)\n"
+    "    jax.config.update('jax_compilation_cache_dir', _d)\n"
+    "    jax.config.update('jax_persistent_cache_min_compile_time_secs',"
+    " 0.0)\n"
     "d = jax.devices()\n"
     "print('DEVICES_OK', d[0].platform, flush=True)\n"
     "x = jnp.ones((128, 128), jnp.bfloat16)\n"

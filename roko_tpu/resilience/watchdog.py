@@ -83,6 +83,52 @@ def hang_diagnostic(stage: str, deadline_s: float) -> str:
     )
 
 
+class DeadlinePolicy:
+    """Split watchdog budgets: compile-grade vs predict-grade.
+
+    The first watched call for a given key (one key per compiled
+    executable — in practice the padded batch size) may legitimately
+    include a cold XLA compile, which can take minutes where steady-state
+    predicts take milliseconds; under a single budget a cold cache either
+    trips the watchdog (compile masquerading as a device hang) or forces
+    the predict deadline so high it stops protecting anything. This
+    policy hands the FIRST call per key ``compile_deadline_s`` and every
+    later call ``predict_deadline_s`` (``ResilienceConfig`` carries
+    both). Thread-safe — parallel warmup probes rungs concurrently."""
+
+    def __init__(
+        self, predict_deadline_s: float, compile_deadline_s: Optional[float] = None
+    ):
+        self.predict_deadline_s = predict_deadline_s
+        self.compile_deadline_s = (
+            predict_deadline_s if compile_deadline_s is None else compile_deadline_s
+        )
+        self._seen: set = set()
+        self._lock = threading.Lock()
+
+    def deadline_for(self, key: Any) -> "tuple[float, bool]":
+        """(budget seconds, is_first_call). Marks the key seen, so the
+        compile budget is spent exactly once per key."""
+        with self._lock:
+            first = key not in self._seen
+            self._seen.add(key)
+        return (self.compile_deadline_s if first else self.predict_deadline_s), first
+
+    def forget(self, key: Any) -> None:
+        """Re-arm the compile budget for ``key``. Called when a FIRST
+        dispatch fails — the failure means no compiled executable landed
+        in the jit cache, so the retry (e.g. after a circuit breaker's
+        half-open probe) must redo the compile and would otherwise be
+        judged by the tight predict deadline, recreating the
+        compile-masquerading-as-hang problem this class exists to fix."""
+        with self._lock:
+            self._seen.discard(key)
+
+    def is_warm(self, key: Any) -> bool:
+        with self._lock:
+            return key in self._seen
+
+
 def call_with_deadline(
     fn: Callable[[], Any],
     deadline_s: float,

@@ -21,7 +21,15 @@ Exposed series (all prefixed ``roko_serve_``):
 - ``breaker_state`` — gauge, 0 closed / 1 half-open / 2 open — and
   ``breaker_trips_total`` — counter — when a
   :class:`roko_tpu.resilience.CircuitBreaker` is attached
-  (docs/SERVING.md "Failure handling").
+  (docs/SERVING.md "Failure handling");
+- ``warmup_seconds`` — gauge, wall time the ladder warmup took (NaN
+  while still warming — the cold-start trajectory a fleet dashboard
+  watches after each deploy);
+
+plus two compile-tier series WITHOUT the serve prefix (they describe
+the process, not the service — docs/SERVING.md "Cold start & compile
+cache"): ``roko_compile_cache_hits`` / ``roko_compile_cache_misses``,
+persistent-compilation-cache counters from :mod:`roko_tpu.compile`.
 """
 
 from __future__ import annotations
@@ -29,6 +37,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, Optional
 
+from roko_tpu.compile.cache import cache_counters
 from roko_tpu.utils.profiling import StageTimer
 
 _PREFIX = "roko_serve_"
@@ -50,6 +59,9 @@ class ServeMetrics:
         self.cpu_fallback: Callable[[], bool] = lambda: False
         #: circuit breaker to render state/trips for (set by make_server)
         self.breaker = None
+        #: ladder warmup wall seconds (set once warmup finishes; None
+        #: renders NaN — "still warming")
+        self.warmup_seconds: Optional[float] = None
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -90,6 +102,17 @@ class ServeMetrics:
             lines.append(
                 f"{_PREFIX}breaker_trips_total {self.breaker.trip_count}"
             )
+        lines.append(f"# TYPE {_PREFIX}warmup_seconds gauge")
+        lines.append(
+            f"{_PREFIX}warmup_seconds "
+            + ("NaN" if self.warmup_seconds is None
+               else f"{self.warmup_seconds:.3f}")
+        )
+        hits, misses = cache_counters()
+        lines.append("# TYPE roko_compile_cache_hits counter")
+        lines.append(f"roko_compile_cache_hits {hits}")
+        lines.append("# TYPE roko_compile_cache_misses counter")
+        lines.append(f"roko_compile_cache_misses {misses}")
         lat = f"{_PREFIX}request_latency_seconds"
         lines.append(f"# TYPE {lat} summary")
         for q in (50, 99):

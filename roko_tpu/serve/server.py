@@ -18,8 +18,11 @@ Routes (payload schema: docs/SERVING.md):
      every contig. Returns ``{"contigs": {name: polished}}``.
 
 - ``GET /healthz`` — liveness + the compiled ladder. Goes **503** while
-  the circuit breaker is open (device failing) or the server is
-  draining, so a load balancer stops routing here.
+  the ladder is still warming (status ``"warming"`` — the socket binds
+  before the compile so restarts are observable, docs/SERVING.md "Cold
+  start & compile cache"), while the circuit breaker is open (device
+  failing), or while the server is draining, so a load balancer stops
+  routing here.
 - ``GET /metrics`` — Prometheus text (``serve/metrics.py``).
 
 Backpressure — queue full, breaker open, or draining — surfaces as
@@ -68,6 +71,12 @@ MAX_BODY_BYTES = 256 * 2**20
 #: or dead batcher worker must surface as an error response, not pin
 #: handler threads (and their connections) forever
 REQUEST_TIMEOUT_S = 600.0
+
+#: Retry-After for the warming 503. The batcher's ``retry_after_s``
+#: (default 1 s) names a queue-drain wait; warmup is a ladder compile
+#: that can take minutes, and a 1 s promise would burn a client's whole
+#: retry budget in seconds against a healthy warming server.
+WARMING_RETRY_AFTER_S = 30.0
 
 
 class _BadRequest(ValueError):
@@ -289,6 +298,11 @@ class _Handler(BaseHTTPRequestHandler):
                     # routing here until half-open probing recovers it
                     body["status"] = "unhealthy"
                     code = 503
+            if self.server._warming.is_set():
+                # bound but not yet compiled: alive (the process is
+                # making progress) but not ready — don't route here yet
+                body["status"] = "warming"
+                code = 503
             if self.server._draining.is_set():
                 body["status"] = "draining"
                 code = 503
@@ -319,6 +333,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply_json(
                     503,
                     {"error": "server draining", "retry_after_s": retry},
+                    extra={"Retry-After": f"{max(1, round(retry))}"},
+                )
+                return
+            if self.server._warming.is_set():
+                # the ladder is still compiling: shed the request now
+                # instead of parking it behind a minutes-long compile
+                # (the socket binds before warmup so restarts are
+                # observable, but work waits for the flip to "ok")
+                retry = max(self.batcher.retry_after_s, WARMING_RETRY_AFTER_S)
+                self._reply_json(
+                    503,
+                    {"error": "server warming up (ladder compiling)",
+                     "retry_after_s": retry},
                     extra={"Retry-After": f"{max(1, round(retry))}"},
                 )
                 return
@@ -396,11 +423,17 @@ def make_server(
     breaker: Optional[CircuitBreaker] = None,
     host: Optional[str] = None,
     port: Optional[int] = None,
+    warming: bool = False,
 ) -> ThreadingHTTPServer:
     """Bind (port 0 = ephemeral) and return the server; the caller runs
     ``serve_forever``. The batcher/metrics/breaker ride on the server
     object (``.batcher`` / ``.metrics`` / ``.breaker``) so tests and the
-    CLI can reach them."""
+    CLI can reach them.
+
+    ``warming=True`` starts the server in the not-ready state: healthz
+    says ``"warming"`` (503) and ``/polish`` sheds with 503+Retry-After
+    until the caller clears ``server._warming`` — the CLI binds the
+    socket first, warms the ladder on a worker thread, then flips it."""
     serve_cfg = serve_cfg or session.cfg.serve
     rcfg = session.cfg.resilience
     metrics = metrics or ServeMetrics(latency_samples=serve_cfg.latency_samples)
@@ -440,6 +473,9 @@ def make_server(
     server.session = session  # type: ignore[attr-defined]
     server.breaker = breaker  # type: ignore[attr-defined]
     server._draining = threading.Event()  # type: ignore[attr-defined]
+    server._warming = threading.Event()  # type: ignore[attr-defined]
+    if warming:
+        server._warming.set()  # type: ignore[attr-defined]
     server._inflight = 0  # type: ignore[attr-defined]
     server._inflight_lock = threading.Lock()  # type: ignore[attr-defined]
     server.drain_deadline_s = rcfg.drain_deadline_s  # type: ignore[attr-defined]

@@ -25,6 +25,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from roko_tpu.compile import load_bundle, warmup_ladder
+from roko_tpu.compile.cache import enable_persistent_cache
+from roko_tpu.compile.warmup import WarmupReport
 from roko_tpu.config import RokoConfig
 from roko_tpu.infer import (
     make_cpu_predict,
@@ -33,7 +36,7 @@ from roko_tpu.infer import (
     rung_for,
 )
 from roko_tpu.models.model import RokoModel
-from roko_tpu.resilience import HangError, call_with_deadline
+from roko_tpu.resilience import DeadlinePolicy, HangError, call_with_deadline
 from roko_tpu.parallel.mesh import (
     AXIS_DP,
     data_sharding,
@@ -61,6 +64,10 @@ class PolishSession:
         ladder: Optional[Sequence[int]] = None,
     ):
         self.cfg = cfg or RokoConfig()
+        # persistent compile cache BEFORE the first compile can happen:
+        # even a bundle-less cold start then pays XLA at most once per
+        # (program, backend, jax version) per machine
+        enable_persistent_cache(self.cfg.compile)
         self.mesh = mesh or make_mesh(self.cfg.mesh)
         rungs = tuple(
             sorted(set(self.cfg.serve.ladder if ladder is None else ladder))
@@ -90,6 +97,20 @@ class PolishSession:
         #: padded batch sizes that have reached the device — after
         #: warmup this must stay a subset of ``ladder`` forever
         self.dispatched_shapes: Set[int] = set()
+        #: AOT-bundle executables by rung (filled by ``warmup`` when a
+        #: bundle is configured); dispatch prefers these over the jit
+        self._aot: Dict[int, Any] = {}
+        #: split watchdog budgets: the FIRST dispatch of each padded
+        #: shape (which may compile) gets ``compile_deadline_s``, every
+        #: later one ``predict_deadline_s`` — a cold cache can no longer
+        #: masquerade as a device hang
+        self._deadlines = DeadlinePolicy(
+            self.resilience.predict_deadline_s,
+            self.resilience.compile_deadline_s,
+        )
+        #: filled by ``warmup``: wall seconds, mode, per-rung timings,
+        #: persistent-cache hit/miss deltas (serve /metrics reads it)
+        self.warmup_report: Optional[WarmupReport] = None
         w = self.cfg.model
         self._window_shape = (w.window_rows, w.window_cols)
 
@@ -104,14 +125,64 @@ class PolishSession:
         except AttributeError:  # pragma: no cover - jax version drift
             return len(self.dispatched_shapes)
 
-    def warmup(self) -> int:
-        """Compile every ladder rung with a zero batch; returns the
-        compiled-entry count. Called once at service start so the first
-        real request pays dispatch cost only."""
-        for rung in self.ladder:
-            x = np.zeros((rung,) + self._window_shape, np.uint8)
-            self._dispatch(x)
-        return self.cache_size()
+    def ready_executables(self) -> int:
+        """Executables live for this session: AOT-loaded rungs plus jit
+        cache entries (a rung is one or the other, never both)."""
+        return len(self._aot) + self.cache_size()
+
+    def warmup(
+        self,
+        *,
+        parallel: Optional[bool] = None,
+        bundle_dir: Optional[str] = None,
+        log=None,
+    ) -> int:
+        """Make every ladder rung hot; returns the ready-executable
+        count. Called once at service start so the first real request
+        pays dispatch cost only.
+
+        Three tiers (roko_tpu/compile, cheapest first): a configured AOT
+        bundle (``CompileConfig.bundle_dir`` / ``--bundle``) deserializes
+        pre-compiled executables — a digest mismatch or missing rung
+        refuses loudly (:class:`~roko_tpu.compile.BundleMismatch`), never
+        silently recompiles; otherwise rungs compile CONCURRENTLY (XLA
+        releases the GIL) through the persistent compilation cache, so
+        only the first-ever start of this program on this machine pays
+        XLA. Either way each rung dispatches one zero batch, proving the
+        executable actually runs before ``/healthz`` flips from
+        ``warming`` to ``ok``. Timings + cache hit/miss deltas land in
+        ``self.warmup_report``."""
+        ccfg = self.cfg.compile
+        bundle_dir = ccfg.bundle_dir if bundle_dir is None else bundle_dir
+        parallel = ccfg.parallel_warmup if parallel is None else parallel
+        mode = None
+        if bundle_dir:
+            self._aot.update(
+                load_bundle(
+                    bundle_dir,
+                    self.cfg,
+                    mesh=self.mesh,
+                    rungs=self.ladder,
+                    require_all=True,
+                    log=log or (lambda m: None),
+                )
+            )
+            mode = "aot"
+
+        def compile_rung(rung: int) -> None:
+            self._dispatch(
+                np.zeros((rung,) + self._window_shape, np.uint8)
+            )
+
+        self.warmup_report = warmup_ladder(
+            self.ladder,
+            compile_rung,
+            parallel=parallel,
+            max_workers=ccfg.warmup_workers,
+            mode=mode,
+            log=log,
+        )
+        return self.ready_executables()
 
     # -- dispatch -----------------------------------------------------------
 
@@ -133,7 +204,8 @@ class PolishSession:
     def _dispatch(self, x: np.ndarray) -> np.ndarray:
         """One padded batch through the device, under the resilience
         watchdog (roko_tpu/resilience): a compile/predict call that
-        outlives ``resilience.predict_deadline_s`` dumps thread stacks
+        outlives its deadline (``compile_deadline_s`` for a shape's
+        first dispatch, ``predict_deadline_s`` after) dumps thread stacks
         and raises :class:`HangError` — the batcher's circuit breaker
         counts it as a device failure — or, with ``hang_fallback ==
         "cpu"``, the session permanently fails over to a host-CPU
@@ -141,17 +213,30 @@ class PolishSession:
         self.dispatched_shapes.add(x.shape[0])
         if self._cpu_predict is not None:
             return self._cpu_predict(x)
+        step = self._aot.get(x.shape[0], self._step)
 
         def run() -> np.ndarray:
-            fut = self._step(self.params, jax.device_put(x, self._sharding))
+            fut = step(self.params, jax.device_put(x, self._sharding))
             return np.asarray(jax.device_get(fut))
 
+        # first dispatch of a shape may include its compile (or AOT
+        # executable validation): it gets the compile-grade budget, the
+        # steady state keeps the tight predict one
+        deadline_s, first = self._deadlines.deadline_for(x.shape[0])
         try:
-            return call_with_deadline(
-                run,
-                self.resilience.predict_deadline_s,
-                stage="serve-predict",
-            )
+            try:
+                return call_with_deadline(
+                    run,
+                    deadline_s,
+                    stage="serve-compile" if first else "serve-predict",
+                )
+            except BaseException:
+                # a failed FIRST dispatch leaves no executable in the jit
+                # cache: re-arm the compile budget so the retry's
+                # recompile isn't judged by the tight predict deadline
+                if first:
+                    self._deadlines.forget(x.shape[0])
+                raise
         except HangError:
             if self.resilience.hang_fallback != "cpu":
                 raise

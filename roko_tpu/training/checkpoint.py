@@ -122,11 +122,25 @@ class CheckpointManager:
         self._ckptr.close()
 
 
+def _tuplify(tree: Any) -> Any:
+    """Restore the model-init pytree structure: orbax round-trips
+    tuples (the per-layer GRU stack) as lists, and an AOT executable
+    (roko_tpu/compile/bundle.py) compiled against the init structure
+    refuses a list-shaped pytree as a different program. Params hold
+    only dicts/tuples of arrays, so list -> tuple is exact."""
+    if isinstance(tree, dict):
+        return {k: _tuplify(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return tuple(_tuplify(v) for v in tree)
+    return tree
+
+
 def load_params(path: str) -> Dict[str, Any]:
     """Load params from a checkpoint directory (best step, falling back
     to ``latest`` when no best-k step exists — e.g. a dir holding only
     the always-current ``latest``, ADVICE r1 (c)) or a single
-    saved-state dir; returns the params pytree."""
+    saved-state dir; returns the params pytree (tuple-canonical, the
+    structure ``model.init`` produces — see :func:`_tuplify`)."""
     path = os.path.abspath(path)
     if os.path.isdir(path):
         entries = os.listdir(path)
@@ -141,10 +155,10 @@ def load_params(path: str) -> Dict[str, Any]:
                 mgr.close()
             if state is None:
                 raise FileNotFoundError(f"no checkpoints under {path}")
-            return state["params"]
+            return _tuplify(state["params"])
     ckptr = ocp.StandardCheckpointer()
     state = ckptr.restore(path)
-    return state["params"] if "params" in state else state
+    return _tuplify(state["params"] if "params" in state else state)
 
 
 def save_params(path: str, params: Dict[str, Any]) -> None:

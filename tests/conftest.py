@@ -5,6 +5,12 @@ multi-chip sharding paths (dp/tp/sp) are exercised without TPU hardware
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The persistent compile cache (roko_tpu/compile) is process-global and
+# on by default; the suite must not write into the user's ~/.cache (or
+# depend on its state). Off unless a test opts in with its own tmpdir —
+# subprocess-spawning tests inherit this too.
+os.environ.setdefault("ROKO_COMPILE_CACHE", "off")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -87,7 +87,7 @@ def test_inference_suite_sweeps_batches_and_takes_best(monkeypatch):
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     rates = {512: 100.0, 2048: 250.0}
-    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1: rates[b])
+    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1, detail=None: rates[b])
     detail = B.run_inference_suite()  # default run sweeps on TPU
     assert set(detail["batch_sweep"]) == {str(b) for b in B.SWEEP_BATCHES}
     # headline is best-of-sweep; the r2-comparable first batch stays
@@ -101,7 +101,7 @@ def test_inference_suite_sweeps_batches_and_takes_best(monkeypatch):
 
 
 def test_inference_suite_no_sweep_off_tpu(monkeypatch):
-    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1: 10.0)
+    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1, detail=None: 10.0)
     detail = B.run_inference_suite()
     assert set(detail["batch_sweep"]) == {str(B.BATCH)}
     assert "pallas_windows_per_sec" not in detail
@@ -294,7 +294,7 @@ def test_measure_flushes_partials_incrementally(monkeypatch, tmp_path):
 
     import pytest
 
-    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=None: 10.0)
+    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=None, detail=None: 10.0)
 
     def boom():
         raise RuntimeError("torch ref exploded")
@@ -324,7 +324,7 @@ def test_measure_flushes_partials_incrementally(monkeypatch, tmp_path):
 
 
 def test_inference_suite_raises_when_all_paths_fail(monkeypatch):
-    def boom(cfg, b, iters=1):
+    def boom(cfg, b, iters=1, detail=None):
         raise ValueError("kernel exploded")
 
     monkeypatch.setattr(B, "bench_infer", boom)

@@ -78,6 +78,14 @@ def test_composed_alignments_are_consistent():
         assert r.pos + ref_len <= len(draft)
 
 
+# slow: each regime trains a model end to end (~10 min apiece on a
+# 2-core box — the tier-1 durations audit showed the pair alone eating
+# the whole 870 s budget and starving every test file after
+# test_end_to_end out of the run). The code paths stay in tier-1 —
+# features/polish/stitch via test_cli + test_stream_pipeline, the train
+# loop via test_training — only the full train-then-polish accuracy
+# property moves to the slow tier (and examples/synthetic_e2e.py).
+@pytest.mark.slow
 @pytest.mark.parametrize("hp", [False, True], ids=["uniform", "homopolymer"])
 def test_polish_reduces_draft_error(tmp_path, hp):
     """Train on genome A, polish held-out genome B: polished error must

@@ -308,12 +308,16 @@ def test_journal_identity_covers_params_and_geometry(tmp_path):
 
 # real predict runs keep the default (generous) watchdog deadline — the
 # first compile on a loaded 2-core CI box can take seconds; only the
-# runs whose predict is a DELIBERATELY blocking fake use HANG_CFG
+# runs whose predict is a DELIBERATELY blocking fake use HANG_CFG.
+# Both budgets shrink: the fake blocks the FIRST dispatch of its shape,
+# which (split watchdog, roko_tpu/compile) runs under compile_deadline_s
 CFG = RokoConfig(model=TINY, mesh=MeshConfig(dp=8))
 HANG_CFG = RokoConfig(
     model=TINY,
     mesh=MeshConfig(dp=8),
-    resilience=ResilienceConfig(predict_deadline_s=0.5),
+    resilience=ResilienceConfig(
+        predict_deadline_s=0.5, compile_deadline_s=0.5
+    ),
 )
 
 
@@ -372,7 +376,9 @@ def test_streaming_hang_watchdog_aborts(synthetic, monkeypatch, tmp_path):
     out = str(tmp_path / "never.fasta")
     msgs = []
     t0 = time.monotonic()
-    with pytest.raises(HangError, match="pipeline-predict-dispatch"):
+    # the fake wedges the FIRST dispatch of its shape, which the split
+    # watchdog budget bills as the compile stage (roko_tpu/compile)
+    with pytest.raises(HangError, match="pipeline-predict-compile"):
         run_streaming_polish(
             None, None, synthetic.params, HANG_CFG,
             out_path=out, batch_size=16, log=msgs.append,
@@ -382,7 +388,7 @@ def test_streaming_hang_watchdog_aborts(synthetic, monkeypatch, tmp_path):
         )
     assert time.monotonic() - t0 < 30.0  # no hang, no deadlocked teardown
     joined = "\n".join(msgs)
-    assert "ROKO_WATCHDOG hang stage=pipeline-predict-dispatch" in joined
+    assert "ROKO_WATCHDOG hang stage=pipeline-predict-compile" in joined
     # no half-written output, and the journal survives for --resume
     assert not (tmp_path / "never.fasta").exists()
     assert (tmp_path / "never.fasta.resume").is_dir()
@@ -410,7 +416,8 @@ def test_streaming_hang_falls_over_to_cpu(synthetic, monkeypatch, tmp_path):
     cfg = dataclasses.replace(
         HANG_CFG,
         resilience=ResilienceConfig(
-            predict_deadline_s=0.5, hang_fallback="cpu"
+            predict_deadline_s=0.5, compile_deadline_s=0.5,
+            hang_fallback="cpu"
         ),
     )
     out = str(tmp_path / "fallback.fasta")

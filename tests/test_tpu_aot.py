@@ -16,6 +16,12 @@ import jax.numpy as jnp
 
 from roko_tpu.config import ModelConfig
 
+# Every test here needs the v5e topology; on a machine without a TPU the
+# libtpu topology init alone can wedge for minutes before the compiles
+# even start, so the whole module runs outside the tier-1 budget. CPU
+# coverage of the AOT bundle machinery lives in test_warmstart.py.
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def v5e_topo():

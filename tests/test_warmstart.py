@@ -1,0 +1,481 @@
+"""Cold-start elimination tests (roko_tpu/compile + the serve warming
+state, docs/SERVING.md "Cold start & compile cache"): persistent-cache
+resolution and enablement, AOT bundle export/load with digest refusal
+(mirroring the resume-journal identity pattern from test_resilience),
+parallel ladder warmup, the split compile/predict watchdog budget
+(hang injection), warming healthz/503, and the new metrics lines."""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.compile import (
+    BundleMismatch,
+    bundle_digest,
+    bundle_identity,
+    export_bundle,
+    load_bundle,
+    read_manifest,
+    warmup_ladder,
+    wrap_predict,
+)
+from roko_tpu.compile import cache as cache_mod
+from roko_tpu.config import (
+    CompileConfig,
+    MeshConfig,
+    ModelConfig,
+    ResilienceConfig,
+    RokoConfig,
+    ServeConfig,
+)
+from roko_tpu.models.model import RokoModel
+from roko_tpu.resilience import DeadlinePolicy, HangError
+from roko_tpu.serve import PolishSession, ServeMetrics, make_server
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+CFG = RokoConfig(
+    model=TINY,
+    mesh=MeshConfig(dp=8),
+    serve=ServeConfig(ladder=(8, 16)),
+)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return RokoModel(TINY).init(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(scope="module")
+def bundle_dir(tmp_path_factory, params):
+    """One exported bundle for the whole module (each rung compile costs
+    real seconds)."""
+    out = str(tmp_path_factory.mktemp("bundle") / "aot")
+    export_bundle(out, CFG, ladder=CFG.serve.ladder, log=lambda m: None)
+    return out
+
+
+# -- config / cache resolution ----------------------------------------------
+
+
+def test_compile_config_json_roundtrip():
+    cfg = RokoConfig(
+        compile=CompileConfig(cache_dir="/x", bundle_dir="/y", cache_max_mb=7)
+    )
+    back = RokoConfig.from_json(cfg.to_json())
+    assert back.compile == cfg.compile
+    assert back.resilience.compile_deadline_s == 1800.0
+
+
+def test_resolve_cache_dir_layering(monkeypatch):
+    monkeypatch.delenv("ROKO_COMPILE_CACHE", raising=False)
+    assert cache_mod.resolve_cache_dir(None).endswith("xla-cache")
+    assert cache_mod.resolve_cache_dir(
+        CompileConfig(cache_dir="/tmp/cc")
+    ) == "/tmp/cc"
+    assert cache_mod.resolve_cache_dir(CompileConfig(enabled=False)) is None
+    # env overrides everything, including an enabled config
+    monkeypatch.setenv("ROKO_COMPILE_CACHE", "/tmp/env-cache")
+    assert cache_mod.resolve_cache_dir(
+        CompileConfig(cache_dir="/tmp/cc")
+    ) == "/tmp/env-cache"
+    for off in ("off", "0", "none", "", "Disabled"):
+        monkeypatch.setenv("ROKO_COMPILE_CACHE", off)
+        assert cache_mod.resolve_cache_dir(None) is None
+
+
+def test_enable_persistent_cache_real_dir_and_idempotence(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("ROKO_COMPILE_CACHE", str(tmp_path / "cc"))
+    old_dir = jax.config.jax_compilation_cache_dir
+    cache_mod._reset_for_tests()
+    try:
+        d = cache_mod.enable_persistent_cache(None)
+        assert d == str(tmp_path / "cc")
+        assert os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+        assert cache_mod.active_cache_dir() == d
+        # idempotent: a second caller with a different dir is ignored
+        monkeypatch.setenv("ROKO_COMPILE_CACHE", str(tmp_path / "other"))
+        notes = []
+        assert cache_mod.enable_persistent_cache(None, log=notes.append) == d
+        assert notes and "already configured" in notes[0]
+        assert cache_mod.cache_entry_count(d) == 0
+        assert cache_mod.cache_total_bytes(d) == 0
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        cache_mod._reset_for_tests()
+
+
+def test_enable_persistent_cache_disabled_is_noop(monkeypatch):
+    monkeypatch.setenv("ROKO_COMPILE_CACHE", "off")
+    cache_mod._reset_for_tests()
+    try:
+        assert cache_mod.enable_persistent_cache(None) is None
+        assert cache_mod.active_cache_dir() is None
+    finally:
+        cache_mod._reset_for_tests()
+
+
+# -- deadline policy / split watchdog budget ---------------------------------
+
+
+def test_deadline_policy_first_call_gets_compile_budget():
+    pol = DeadlinePolicy(0.5, 1800.0)
+    assert pol.deadline_for(128) == (1800.0, True)
+    assert pol.deadline_for(128) == (0.5, False)
+    assert not pol.is_warm(256)
+    assert pol.deadline_for(256) == (1800.0, True)
+    assert pol.is_warm(256)
+    # compile budget defaults to the predict budget when unset
+    assert DeadlinePolicy(7.0).deadline_for("k") == (7.0, True)
+
+
+def test_deadline_policy_forget_rearms_compile_budget():
+    """A failed first dispatch leaves no executable behind: ``forget``
+    re-arms the compile budget so the retry's recompile isn't judged by
+    the tight predict deadline (e.g. after a breaker half-open probe)."""
+    pol = DeadlinePolicy(0.5, 1800.0)
+    assert pol.deadline_for(128) == (1800.0, True)
+    pol.forget(128)
+    assert not pol.is_warm(128)
+    assert pol.deadline_for(128) == (1800.0, True)
+    assert pol.deadline_for(128) == (0.5, False)
+    # forgetting an unseen key is a no-op
+    pol.forget("never-seen")
+
+
+def test_cold_compile_hang_trips_compile_deadline(params):
+    """Hang injection (ISSUE satellite): a wedged FIRST dispatch blows
+    ``compile_deadline_s`` — not the (much larger) predict budget — and
+    surfaces as HangError from warmup."""
+    cfg = dataclasses.replace(
+        CFG,
+        serve=ServeConfig(ladder=(8,)),
+        resilience=ResilienceConfig(
+            predict_deadline_s=600.0, compile_deadline_s=0.3
+        ),
+    )
+    session = PolishSession(params, cfg)
+    session._step = lambda p, x: time.sleep(30)  # blocking fake compile
+    with pytest.raises(HangError, match="serve-compile"):
+        session.warmup(parallel=False)
+
+
+def test_slow_cold_compile_survives_tight_predict_deadline(params):
+    """The satellite's point: a legitimately slow first compile must NOT
+    trip the tight predict deadline — only post-warmup calls run under
+    it."""
+    cfg = dataclasses.replace(
+        CFG,
+        serve=ServeConfig(ladder=(8,)),
+        resilience=ResilienceConfig(
+            predict_deadline_s=0.25, compile_deadline_s=600.0
+        ),
+    )
+    session = PolishSession(params, cfg)
+    calls = []
+
+    def fake_step(p, x):
+        calls.append(x.shape[0])
+        if len(calls) == 1:
+            time.sleep(0.6)  # "cold compile": slower than predict budget
+        return np.zeros((x.shape[0], TINY.window_cols), np.int32)
+
+    session._step = fake_step
+    session.warmup(parallel=False)  # survives: first call = compile budget
+    assert calls == [8]
+    # steady state is back under the tight predict deadline: a hang now
+    # (same slow fake) trips it
+    session._step = lambda p, x: time.sleep(30)
+    with pytest.raises(HangError, match="serve-predict"):
+        session._dispatch(np.zeros((8, 200, 90), np.uint8))
+
+
+# -- parallel warmup ---------------------------------------------------------
+
+
+def test_warmup_ladder_runs_every_rung_concurrently():
+    started = threading.Barrier(2, timeout=10.0)
+    done = []
+
+    def compile_rung(r):
+        started.wait()  # both rungs must be in flight at once
+        done.append(r)
+
+    report = warmup_ladder((8, 16), compile_rung, parallel=True, log=None)
+    assert sorted(done) == [8, 16]
+    assert report.mode == "parallel"
+    assert set(report.per_rung_s) == {8, 16}
+    assert report.seconds > 0
+
+
+def test_warmup_ladder_serial_and_failure_propagation():
+    order = []
+    report = warmup_ladder((4, 2), order.append, parallel=False, log=None)
+    assert order == [4, 2] and report.mode == "serial"
+
+    def boom(r):
+        if r == 16:
+            raise RuntimeError("rung 16 exploded")
+
+    with pytest.raises(RuntimeError, match="rung 16 exploded"):
+        warmup_ladder((8, 16), boom, parallel=True, log=None)
+
+
+def test_session_parallel_warmup_compiles_whole_ladder(params):
+    session = PolishSession(params, CFG)
+    n = session.warmup(parallel=True)
+    assert n >= len(session.ladder)
+    assert session.cache_size() >= len(session.ladder)
+    assert session.dispatched_shapes == set(session.ladder)
+    rep = session.warmup_report
+    assert rep is not None and rep.mode == "parallel"
+    assert set(rep.per_rung_s) == set(session.ladder)
+    # steady state: no new shapes, no recompiles (the PR-1 acceptance
+    # bar survives the warmup rewrite)
+    compiled = session.cache_size()
+    rng = np.random.default_rng(0)
+    for n_wins in (3, 9, 16):
+        session.predict(
+            rng.integers(0, C.FEATURE_VOCAB, (n_wins, 200, 90)).astype(
+                np.uint8
+            )
+        )
+    assert session.cache_size() == compiled
+    assert session.dispatched_shapes <= set(session.ladder)
+
+
+# -- AOT bundles -------------------------------------------------------------
+
+
+def test_bundle_roundtrip_identical_and_zero_jit_compiles(
+    params, bundle_dir, rng
+):
+    """`roko-tpu compile` -> load: the AOT session compiles NOTHING
+    (jit cache stays empty) and its predictions are byte-identical to
+    the jit session's."""
+    jit_session = PolishSession(params, CFG)
+    jit_session.warmup()
+    cfg = dataclasses.replace(CFG, compile=CompileConfig(bundle_dir=bundle_dir))
+    aot_session = PolishSession(params, cfg)
+    ready = aot_session.warmup(log=None)
+    assert ready == len(CFG.serve.ladder)
+    assert aot_session.warmup_report.mode == "aot"
+    assert aot_session.cache_size() == 0  # zero XLA compiles
+    x = rng.integers(0, C.FEATURE_VOCAB, (20, 200, 90)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        aot_session.predict(x), jit_session.predict(x)
+    )
+    assert aot_session.cache_size() == 0  # still none after real traffic
+
+
+def test_export_never_reads_or_writes_compile_cache(tmp_path, monkeypatch):
+    """Export must compile for real even on a warm-cache machine:
+    serializing an executable XLA deserialized from the persistent
+    cache writes a stub missing its compiled symbols — the bundle then
+    fails every cross-process load with INTERNAL "Symbols not found".
+    Pin the guard: with the cache enabled, an export neither hits nor
+    misses it, and leaves the flag restored."""
+    cache_mod._reset_for_tests()
+    monkeypatch.setenv("ROKO_COMPILE_CACHE", str(tmp_path / "cache"))
+    try:
+        assert cache_mod.enable_persistent_cache() is not None
+        hits0, misses0 = cache_mod.cache_counters()
+        export_bundle(
+            str(tmp_path / "aot"), CFG, ladder=(8,), log=lambda m: None
+        )
+        assert cache_mod.cache_counters() == (hits0, misses0)
+        assert jax.config.jax_enable_compilation_cache
+    finally:
+        cache_mod._reset_for_tests()
+
+
+def test_bundle_manifest_contents(bundle_dir):
+    man = read_manifest(bundle_dir)
+    assert man["rungs"] == [8, 16]
+    assert man["digest"] == bundle_digest(man["identity"])
+    ident = man["identity"]
+    assert ident["backend"] == "cpu"
+    assert ident["jax_version"] == jax.__version__
+    assert ident["mesh"]["dp"] == 8
+    assert ident["model"]["hidden_size"] == TINY.hidden_size
+
+
+def test_bundle_refuses_model_and_geometry_drift(bundle_dir):
+    """Identity refusal, mirroring the resume-journal pattern: any field
+    the compiled program depends on differs -> BundleMismatch naming it,
+    never a silent recompile-with-wrong-results."""
+    wider = dataclasses.replace(CFG, model=dataclasses.replace(TINY, hidden_size=32))
+    with pytest.raises(BundleMismatch, match="hidden_size"):
+        load_bundle(bundle_dir, wider, log=lambda m: None)
+    narrow = dataclasses.replace(
+        CFG, model=dataclasses.replace(TINY, window_cols=80)
+    )
+    with pytest.raises(BundleMismatch, match="window_cols"):
+        load_bundle(bundle_dir, narrow, log=lambda m: None)
+
+
+@pytest.mark.parametrize(
+    "field,value,needle",
+    [
+        ("jax_version", "0.0.1", "jax_version"),
+        ("device_kind", "TPU v9", "device_kind"),
+        ("mesh", {"dp": 4, "tp": 1, "sp": 1}, "mesh.dp"),
+    ],
+)
+def test_bundle_refuses_environment_drift(
+    bundle_dir, tmp_path, field, value, needle
+):
+    """A bundle built under another jax version / device kind / mesh
+    must refuse even though every config field matches (serialized
+    executables are not portable across compilers or topologies). The
+    foreign identity is injected by manifest rewrite — the drifted
+    environments can't be constructed in-process."""
+    import shutil
+
+    other = tmp_path / "aged"
+    shutil.copytree(bundle_dir, other)
+    man = read_manifest(str(other))
+    man["identity"][field] = value
+    man["digest"] = bundle_digest(man["identity"])  # internally consistent
+    with open(other / "manifest.json", "w") as f:
+        json.dump(man, f)
+    with pytest.raises(BundleMismatch, match=needle):
+        load_bundle(str(other), CFG, log=lambda m: None)
+
+
+def test_bundle_refuses_missing_rung_and_missing_manifest(
+    bundle_dir, tmp_path
+):
+    with pytest.raises(BundleMismatch, match=r"missing \[24\]"):
+        load_bundle(
+            bundle_dir, CFG, rungs=(8, 16, 24), require_all=True,
+            log=lambda m: None,
+        )
+    # non-required missing rungs just load the intersection
+    execs = load_bundle(
+        bundle_dir, CFG, rungs=(8, 24), log=lambda m: None
+    )
+    assert sorted(execs) == [8]
+    with pytest.raises(FileNotFoundError, match="manifest.json"):
+        read_manifest(str(tmp_path / "empty"))
+
+
+def test_wrap_predict_routes_by_batch_rows():
+    hits = []
+    wrapped = wrap_predict(
+        lambda p, x: hits.append(("jit", x.shape[0])),
+        {8: lambda p, x: hits.append(("aot", x.shape[0]))},
+    )
+    wrapped(None, np.zeros((8, 1, 1)))
+    wrapped(None, np.zeros((4, 1, 1)))
+    assert hits == [("aot", 8), ("jit", 4)]
+    step = lambda p, x: None  # noqa: E731
+    assert wrap_predict(step, {}) is step
+
+
+def test_cli_compile_writes_loadable_bundle(tmp_path, capsys):
+    """The `roko-tpu compile` -> `--bundle` round trip through the real
+    CLI surface (serve/polish load through the same load_bundle)."""
+    from roko_tpu.cli import main
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(CFG.to_json())
+    out = str(tmp_path / "bundle")
+    rc = main(
+        ["compile", out, "--config", str(cfg_path), "--ladder", "8,16"]
+    )
+    assert rc == 0
+    assert "digest" in capsys.readouterr().out
+    execs = load_bundle(out, CFG, rungs=(8, 16), require_all=True,
+                        log=lambda m: None)
+    assert sorted(execs) == [8, 16]
+
+
+# -- serve warming state -----------------------------------------------------
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def test_server_binds_first_and_sheds_until_warm(params):
+    session = PolishSession(params, CFG)
+    session.warmup()  # executables ready; the FLAG drives the behavior
+    server = make_server(session, CFG.serve, port=0, warming=True)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    host, port = server.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        code, body = _get(f"{base}/healthz")
+        assert (code, body["status"]) == (503, "warming")
+        req = urllib.request.Request(
+            f"{base}/polish", data=b"{}", method="POST"
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 503
+        assert ei.value.headers.get("Retry-After") is not None
+        assert "warming" in json.loads(ei.value.read().decode())["error"]
+        server._warming.clear()
+        code, body = _get(f"{base}/healthz")
+        assert (code, body["status"]) == (200, "ok")
+    finally:
+        server.shutdown()
+        server.batcher.stop()
+        server.server_close()
+        t.join(timeout=5)
+
+
+def test_metrics_render_warmup_and_cache_lines():
+    m = ServeMetrics()
+    text = m.render()
+    assert "roko_serve_warmup_seconds NaN" in text
+    assert "roko_compile_cache_hits" in text
+    assert "roko_compile_cache_misses" in text
+    m.warmup_seconds = 12.5
+    assert "roko_serve_warmup_seconds 12.500" in m.render()
+
+
+# -- bench coldstart suite ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_coldstart_suite_reports_speedups(tmp_path):
+    """The bench suite end to end on a tiny model: three child
+    processes + an export child, speedup fields present, warm paths not
+    slower than cold by more than noise allows (the >=5x acceptance bar
+    is asserted on the REAL model by the driver's bench, not here —
+    a tiny model's compile is too fast to bound reliably)."""
+    from roko_tpu.benchmark import run_coldstart_suite
+
+    cfg = RokoConfig(model=TINY, mesh=MeshConfig(dp=8))
+    res = run_coldstart_suite(
+        ladder=(8,), child_budget_s=600.0, config_json=cfg.to_json()
+    )
+    for key in ("cold", "cold_parallel", "warm_cache", "aot"):
+        assert res[key]["ttfp_s"] > 0
+        assert res[key]["warmup"]["mode"] in ("parallel", "serial", "aot")
+    assert res["cold"]["warmup"]["mode"] == "serial"
+    assert res["aot"]["warmup"]["mode"] == "aot"
+    assert res["cold"]["warmup"]["cache_misses"] >= 1
+    assert res["warm_cache"]["warmup"]["cache_hits"] >= 1
+    assert res["export_seconds"] > 0
+    assert "speedup_warm_cache" in res and "speedup_aot" in res
+    assert "speedup_cold_parallel" in res

@@ -1,0 +1,65 @@
+"""One-liner inventory of the cold-start artifacts on this host:
+persistent compile cache (dir, entry count, bytes) and, per AOT bundle,
+its digest + rungs + the backend/jax it was built for.
+
+    python tools/cache_probe.py                     # the resolved cache
+    python tools/cache_probe.py --cache DIR         # a specific cache
+    python tools/cache_probe.py --bundle DIR [...]  # bundle digests too
+
+Reads only — safe to run next to a live service. Exit 0 always (an
+absent cache is a fact, not a failure). ``ROKO_COMPILE_CACHE`` is
+honored, so the line this prints is the line ``roko-tpu serve`` will
+actually use (docs/SERVING.md "Cold start & compile cache").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cache", default=None, help="cache dir (default: resolved config)")
+    ap.add_argument(
+        "--bundle", action="append", default=[],
+        help="AOT bundle dir(s) to summarise (repeatable)",
+    )
+    args = ap.parse_args()
+
+    from roko_tpu.compile import read_manifest
+    from roko_tpu.compile.cache import (
+        cache_entry_count,
+        cache_total_bytes,
+        resolve_cache_dir,
+    )
+
+    cache_dir = args.cache or resolve_cache_dir()
+    if cache_dir is None:
+        print("cache: DISABLED (ROKO_COMPILE_CACHE=off)")
+    else:
+        n = cache_entry_count(cache_dir)
+        mb = cache_total_bytes(cache_dir) / 2**20
+        state = "" if os.path.isdir(cache_dir) else " (not created yet)"
+        print(f"cache: {cache_dir} entries={n} size={mb:.1f}MiB{state}")
+
+    for bundle in args.bundle:
+        try:
+            man = read_manifest(bundle)
+        except FileNotFoundError as e:
+            print(f"bundle: {bundle} INVALID — {e}")
+            continue
+        ident = man.get("identity", {})
+        print(
+            f"bundle: {bundle} digest={man.get('digest', '?')[:12]} "
+            f"rungs={man.get('rungs')} backend={ident.get('backend')}/"
+            f"{ident.get('device_kind')} jax={ident.get('jax_version')}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,13 @@ would call that chip healthy and a full bench budget would burn on it.
 This tool is a thin shell over the shared watchdog/probe subsystem
 (``roko_tpu.resilience.probe`` — the same implementation the bench
 orchestration uses); it owns no deadline logic of its own.
+
+Side benefit: the canary child enables the persistent compilation cache
+(``ROKO_COMPILE_CACHE`` resolution, default ``~/.cache/roko-tpu/
+xla-cache``), so probing a chip also WARMS the cache — the canary
+compile is a disk hit for every later process on this host. Inspect the
+cache with ``python tools/cache_probe.py``; opt out with
+``ROKO_COMPILE_CACHE=off``.
 """
 
 from __future__ import annotations
