@@ -109,6 +109,49 @@ def _resilience_args(p: argparse.ArgumentParser, serve: bool = False) -> None:
         )
 
 
+def _guard_args(p: argparse.ArgumentParser) -> None:
+    """Bulletproof-training sentinel knobs (GuardConfig,
+    docs/TRAINING.md "Failure handling")."""
+    p.add_argument(
+        "--no-guard", action="store_true", default=None,
+        help="disable the NaN/loss-spike sentinel (restores the fused "
+        "train step: no per-step host sync, no skip/rollback; "
+        "--save-every-steps checkpoints still work)",
+    )
+    p.add_argument(
+        "--spike-sigma", type=float, default=None,
+        help="skip an update whose loss is more than this many EMA "
+        "standard deviations above the loss EMA (default 6; one-sided)",
+    )
+    p.add_argument(
+        "--max-bad-steps", type=int, default=None,
+        help="consecutive skipped steps that trigger a rollback to the "
+        "last good checkpoint with a re-jittered dropout RNG stream "
+        "(default 3)",
+    )
+    p.add_argument(
+        "--max-rollbacks", type=int, default=None,
+        help="rollbacks after which the run aborts loudly — a "
+        "deterministic fault replays identically (default 3)",
+    )
+    p.add_argument(
+        "--guard-ema-beta", type=float, default=None,
+        help="decay of the loss EMA/variance the spike detector uses "
+        "(default 0.98)",
+    )
+    p.add_argument(
+        "--guard-warmup-steps", type=int, default=None,
+        help="good steps of EMA history before spike detection arms "
+        "(default 20; non-finite detection is always armed)",
+    )
+    p.add_argument(
+        "--save-every-steps", type=int, default=None,
+        help="also checkpoint (latest-only) every N steps inside an "
+        "epoch, carrying the data position so --resume replays from "
+        "exactly that batch (default 0 = epoch boundaries only)",
+    )
+
+
 def _window_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--window-rows", type=int, default=None, help="pileup rows per window")
     p.add_argument("--window-cols", type=int, default=None, help="pileup columns per window")
@@ -201,10 +244,20 @@ def _build_config(args: argparse.Namespace):
     )
     if getattr(args, "no_compile_cache", None):
         compile_cfg = dataclasses.replace(compile_cfg, enabled=False)
+    guard = over(
+        base.guard,
+        spike_sigma="spike_sigma", max_bad_steps="max_bad_steps",
+        max_rollbacks="max_rollbacks", ema_beta="guard_ema_beta",
+        warmup_steps="guard_warmup_steps",
+        save_every_steps="save_every_steps",
+    )
+    if getattr(args, "no_guard", None):
+        guard = dataclasses.replace(guard, enabled=False)
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, mesh=mesh, serve=serve,
         pipeline=pipeline, resilience=resilience, compile=compile_cfg,
+        guard=guard,
     )
 
 
@@ -696,6 +749,7 @@ def build_parser() -> argparse.ArgumentParser:
     _model_args(p)
     _mesh_args(p)
     _window_args(p)
+    _guard_args(p)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("inference", help="features HDF5 + checkpoint -> polished FASTA")

@@ -250,6 +250,39 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class GuardConfig:
+    """Bulletproof-training sentinel (roko_tpu/training/guard.py,
+    docs/TRAINING.md "Failure handling"): NaN/Inf and loss-spike
+    detection with update-skip and checkpoint rollback, plus the
+    step-granular checkpoint cadence."""
+
+    #: sentinel switch — False restores the fused train step (no
+    #: per-step host sync, no skip/rollback). ``save_every_steps`` is
+    #: independent of it: step-granular checkpoints work either way.
+    enabled: bool = True
+    #: a loss further than this many EMA standard deviations ABOVE the
+    #: loss EMA is a spike: the update is skipped (one-sided — fast
+    #: improvement is never penalised)
+    spike_sigma: float = 6.0
+    #: decay of the loss EMA and its variance EMA
+    ema_beta: float = 0.98
+    #: good steps of EMA history required before spike detection arms
+    #: (non-finite detection is armed from step 0)
+    warmup_steps: int = 20
+    #: consecutive bad (skipped) steps that trigger a rollback to the
+    #: last good checkpoint with a re-jittered dropout RNG stream
+    max_bad_steps: int = 3
+    #: rollbacks after which the run gives up loudly (a deterministic
+    #: fault replays identically; re-jittering only helps transients)
+    max_rollbacks: int = 3
+    #: ALSO checkpoint (latest-only, not best-k) every N optimiser
+    #: steps inside an epoch, carrying the data-pipeline position so
+    #: --resume replays from exactly that batch; 0 = epoch-boundary
+    #: checkpoints only
+    save_every_steps: int = 0
+
+
+@dataclass(frozen=True)
 class RokoConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
@@ -261,6 +294,7 @@ class RokoConfig:
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
+    guard: GuardConfig = field(default_factory=GuardConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -281,6 +315,7 @@ class RokoConfig:
             pipeline=PipelineConfig(**raw.get("pipeline", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             compile=CompileConfig(**raw.get("compile", {})),
+            guard=GuardConfig(**raw.get("guard", {})),
         )
 
 

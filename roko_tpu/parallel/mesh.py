@@ -31,6 +31,15 @@ AXIS_DP = "dp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 
+# Random bits must not depend on how the consuming array is sharded: the
+# non-partitionable threefry lowering (this jax's default) generates
+# different dropout masks on a dp-only vs dp x tp mesh — the tp train
+# step's loss diverged 6e-3 from the replicated run on identical inputs,
+# breaking cross-mesh parity and the bit-identical resume contract.
+# Partitionable threefry (the default on later jax) is sharding-invariant;
+# force it here, where every mesh is built.
+jax.config.update("jax_threefry_partitionable", True)
+
 
 def mesh_shape(
     cfg: MeshConfig, n_devices: Optional[int] = None

@@ -22,7 +22,11 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.6 exports shard_map at the top level
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:  # older jax keeps it in experimental
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from roko_tpu.parallel.mesh import AXIS_DP, AXIS_SP
@@ -97,12 +101,21 @@ def make_ring_attention(mesh: Mesh, num_heads: int):
         axis_name=AXIS_SP,
         n_shards=mesh.shape[AXIS_SP],
     )
+    # the replication-check kwarg was renamed check_rep -> check_vma
+    # across jax versions; pass whichever this jax accepts
+    import inspect
+
+    check_kw = (
+        "check_vma"
+        if "check_vma" in inspect.signature(shard_map).parameters
+        else "check_rep"
+    )
     sharded = shard_map(
         lambda q, k, v: local(q, k, v),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        check_vma=False,
+        **{check_kw: False},
     )
 
     def attn_fn(q, k, v, heads):

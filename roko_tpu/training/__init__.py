@@ -6,10 +6,15 @@ pytorch-ignite engines (ref: roko/train.py).
 - `roko_tpu.training.loop` — jitted train/eval steps sharded over the
   device mesh, epoch driver, early stopping.
 - `roko_tpu.training.checkpoint` — Orbax checkpoints carrying params,
-  optimizer state and step (the reference kept best-model params only,
-  SURVEY.md §5.4).
+  optimizer state, step and the data-pipeline position, with a sha256
+  integrity chain (committed manifests, verified fallback restore — the
+  reference kept best-model params only, SURVEY.md §5.4).
+- `roko_tpu.training.guard` — NaN/loss-spike sentinel: skip bad
+  updates, roll back to the last good checkpoint after consecutive bad
+  steps (docs/TRAINING.md "Failure handling").
 """
 
+from roko_tpu.training.guard import TrainGuard
 from roko_tpu.training.loop import TrainState, train
 
-__all__ = ["train", "TrainState"]
+__all__ = ["train", "TrainState", "TrainGuard"]

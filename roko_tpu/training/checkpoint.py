@@ -1,4 +1,4 @@
-"""Orbax checkpointing.
+"""Orbax checkpointing with an integrity chain.
 
 The reference checkpoints model params only, keyed by validation accuracy
 (ignite ModelCheckpoint, ref: roko/train.py:82-84) — no optimizer state,
@@ -6,15 +6,138 @@ no resume. Here every checkpoint carries ``{params, opt_state, step}``
 plus the val-accuracy metric, the manager keeps the best-k by val_acc,
 and ``restore_latest``/``restore_best`` give both resume-from-step and
 best-model-for-inference (SURVEY.md §5.3-5.4 build notes).
+
+Integrity chain (docs/TRAINING.md "Failure handling"): every save
+commits a ``roko_manifest.json`` — a sha256 per leaf file plus a digest
+of the whole tree — ATOMICALLY (tmp + rename) after the orbax write
+finishes, so a SIGKILL mid-save leaves a checkpoint *without* a
+committed manifest rather than a silently-truncated one. Restore walks
+the candidates newest-first (``latest``, then numbered steps), verifies
+each manifest, logs a loud ``ROKO_GUARD`` line on corruption, and falls
+back to the next older good checkpoint. When checkpoints exist on disk
+but none verifies, restore raises :class:`CheckpointIntegrityError`
+instead of silently training from scratch.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import jax
 import orbax.checkpoint as ocp
+
+#: committed last, atomically — its presence IS the commit record
+MANIFEST_NAME = "roko_manifest.json"
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """No checkpoint on disk passes manifest verification; refusing to
+    silently start from scratch over existing (corrupt) state."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def _manifest_entries(ckpt_dir: str) -> Dict[str, Dict[str, Any]]:
+    """``relpath -> {sha256, bytes}`` for every file under ``ckpt_dir``
+    except the manifest itself."""
+    entries: Dict[str, Dict[str, Any]] = {}
+    for dirpath, dirnames, filenames in os.walk(ckpt_dir):
+        dirnames.sort()
+        for name in sorted(filenames):
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, ckpt_dir)
+            if rel == MANIFEST_NAME:
+                continue
+            entries[rel] = {
+                "sha256": _sha256_file(path),
+                "bytes": os.path.getsize(path),
+            }
+    return entries
+
+
+def _tree_digest(entries: Dict[str, Dict[str, Any]]) -> str:
+    """Structure digest: file set + per-file hashes, order-independent."""
+    lines = [f"{rel}:{entries[rel]['sha256']}" for rel in sorted(entries)]
+    return hashlib.sha256("\n".join(lines).encode()).hexdigest()
+
+
+def write_manifest(ckpt_dir: str) -> str:
+    """Hash every leaf file under ``ckpt_dir`` and commit the manifest
+    atomically (write tmp, fsync, rename). Returns the manifest path.
+    Call only after the checkpoint write has fully finished."""
+    entries = _manifest_entries(ckpt_dir)
+    manifest = {
+        "version": 1,
+        "tree_digest": _tree_digest(entries),
+        "files": entries,
+    }
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    # fsync the directory so the rename itself survives a crash
+    dir_fd = os.open(ckpt_dir, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+    return path
+
+
+def verify_manifest(ckpt_dir: str) -> Tuple[str, str]:
+    """Verify ``ckpt_dir`` against its committed manifest.
+
+    Returns ``(status, detail)`` with status one of:
+
+    - ``"ok"``         — manifest present, every file matches;
+    - ``"corrupt"``    — manifest unreadable, a file is missing,
+      truncated, or its hash mismatches (detail names the first);
+    - ``"unverified"`` — no manifest (pre-integrity legacy layout, or a
+      save that was killed before commit).
+    """
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return "unverified", "no manifest"
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+        digest = manifest["tree_digest"]
+    except (OSError, ValueError, KeyError) as e:
+        return "corrupt", f"unreadable manifest ({e})"
+    if _tree_digest(files) != digest:
+        return "corrupt", "manifest tree digest mismatch"
+    for rel, want in sorted(files.items()):
+        fpath = os.path.join(ckpt_dir, rel)
+        if not os.path.exists(fpath):
+            return "corrupt", f"missing file {rel}"
+        size = os.path.getsize(fpath)
+        if size != want["bytes"]:
+            return (
+                "corrupt",
+                f"truncated file {rel} ({size} != {want['bytes']} bytes)",
+            )
+        if _sha256_file(fpath) != want["sha256"]:
+            return "corrupt", f"sha256 mismatch on {rel}"
+    return "ok", f"{len(files)} files verified"
+
+
+def _default_log(msg: str) -> None:
+    import sys
+
+    print(msg, file=sys.stderr)
 
 
 class CheckpointManager:
@@ -25,10 +148,20 @@ class CheckpointManager:
     a checkpoint many epochs old. ``save`` therefore also overwrites a
     standalone ``latest`` checkpoint every call; ``restore_latest``
     prefers it.
+
+    Every save commits a sha256 manifest after the orbax write;
+    ``restore_latest`` verifies candidates newest-first and falls back
+    along the chain on corruption (module docstring).
     """
 
-    def __init__(self, directory: str, keep: int = 3):
+    def __init__(
+        self,
+        directory: str,
+        keep: int = 3,
+        log: Optional[Callable[[str], None]] = None,
+    ):
         self.directory = os.path.abspath(directory)
+        self._log = log if log is not None else _default_log
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -50,67 +183,190 @@ class CheckpointManager:
     def _latest_path(self) -> str:
         return os.path.join(self.directory, "latest")
 
+    def _step_path(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
     def save(self, step: int, state: Dict[str, Any], val_acc: float) -> None:
+        """Full save: best-k step + the always-current ``latest``, both
+        with committed manifests. Synchronous — the integrity chain
+        hashes the files, so the orbax write must have finished."""
         self._mgr.save(
             step,
             args=ocp.args.StandardSave(state),
             metrics={"val_acc": float(val_acc)},
         )
         self._ckptr.save(self._latest_path, state, force=True)
+        self.wait()
+        self._commit_manifests([self._step_path(step), self._latest_path])
+
+    def save_latest(self, state: Dict[str, Any]) -> None:
+        """Mid-epoch save: overwrite ``latest`` only (no best-k entry —
+        there is no val metric mid-epoch) and commit its manifest. Used
+        for the step-granular checkpoint cadence
+        (``GuardConfig.save_every_steps``)."""
+        self._ckptr.save(self._latest_path, state, force=True)
+        self._ckptr.wait_until_finished()
+        self._commit_manifests([self._latest_path])
+
+    def _commit_manifests(self, paths) -> None:
+        """Write+commit a manifest per checkpoint dir. Primary-only on
+        multi-host (every process writes its shards, but two writers of
+        one manifest would race); a dir the best-k pruner already
+        deleted is skipped. Kept as a separate seam so fault-injection
+        tests can SIGKILL between the orbax write and the commit."""
+        if jax.process_index() != 0:
+            return
+        for path in paths:
+            if os.path.isdir(path):
+                write_manifest(path)
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
         self._ckptr.wait_until_finished()
 
-    def _restore(self, step: Optional[int], like: Optional[Dict[str, Any]]):
-        if step is None:
-            return None
-        if like is not None:
-            target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
-            return self._mgr.restore(step, args=ocp.args.StandardRestore(target))
-        # targetless restore still needs explicit args: a FRESH manager
-        # (load_params opens one per call) has no handler registered for
-        # the "default" item and a bare restore() raises KeyError on
-        # orbax >= 0.7 (the registry is only populated by a save)
-        return self._mgr.restore(step, args=ocp.args.StandardRestore())
+    # -- verified restore chain ------------------------------------------
 
-    def restore_latest(self, like=None) -> Optional[Dict[str, Any]]:
+    def _candidates(self) -> List[Tuple[Union[str, int], str]]:
+        """Restore candidates newest-first: ``latest`` (overwritten on
+        every save), then numbered best-k steps descending."""
+        out: List[Tuple[Union[str, int], str]] = []
         if os.path.exists(self._latest_path):
+            out.append(("latest", self._latest_path))
+        steps = self._mgr.all_steps() or []
+        for step in sorted(steps, reverse=True):
+            out.append((int(step), self._step_path(int(step))))
+        return out
+
+    def _keys_at(self, name: Union[str, int]) -> Optional[set]:
+        """Top-level key names of one candidate checkpoint."""
+        if name == "latest":
+            self._ckptr.wait_until_finished()
+            meta = self._ckptr.metadata(self._latest_path)
+        else:
+            meta = self._mgr.item_metadata(int(name))
+        # orbax < 0.7 wrapped the tree (meta.item_metadata.tree); 0.7
+        # returns the metadata tree itself as a plain dict. Two separate
+        # getattr steps: the fallback at each level must be the value
+        # from the level above, not the original wrapper, or an
+        # item_metadata-without-tree shape resolves back to the wrapper
+        # and .keys() explodes
+        inner = getattr(meta, "item_metadata", meta)
+        tree = getattr(inner, "tree", inner)
+        if tree is None:
+            # orbax 0.7 fresh-manager quirk: a manager that has never
+            # SAVED in this process has no metadata handler for the
+            # step's "default" item and returns an empty wrapper (the
+            # metadata analogue of the targetless-restore KeyError).
+            # Fall back to a targetless restore purely for the key set
+            # — only the fallback-to-numbered-step path pays the extra
+            # read, and only on a fresh process.
+            return set(self._restore_at(name, None).keys())
+        return set(tree.keys())
+
+    def _restore_at(self, name: Union[str, int], like):
+        if name == "latest":
             self._ckptr.wait_until_finished()
             if like is not None:
                 target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
                 return self._ckptr.restore(self._latest_path, target)
             return self._ckptr.restore(self._latest_path)
-        return self._restore(self._mgr.latest_step(), like)
+        if like is not None:
+            target = jax.tree.map(ocp.utils.to_shape_dtype_struct, like)
+            return self._mgr.restore(
+                int(name), args=ocp.args.StandardRestore(target)
+            )
+        # targetless restore still needs explicit args: a FRESH manager
+        # (load_params opens one per call) has no handler registered for
+        # the "default" item and a bare restore() raises KeyError on
+        # orbax >= 0.7 (the registry is only populated by a save)
+        return self._mgr.restore(int(name), args=ocp.args.StandardRestore())
+
+    def restore_latest(
+        self, like=None, *, template: Optional[Dict[str, Any]] = None
+    ) -> Optional[Dict[str, Any]]:
+        """Restore the newest checkpoint that VERIFIES, walking the
+        fallback chain (``latest``, then numbered steps newest-first)
+        past corrupt or uncommitted candidates with a loud ``ROKO_GUARD``
+        line per skip.
+
+        ``like`` is a fixed restore target used as-is for every
+        candidate. ``template`` is a superset target filtered per
+        candidate to its actual on-disk keys — resume uses it so older
+        layouts restore without guessing (ADVICE r1 (a)).
+
+        Raises :class:`CheckpointIntegrityError` when checkpoints exist
+        but none verifies — never a silent fresh start. Candidates
+        without a manifest are accepted (legacy layout) unless some
+        OTHER checkpoint in the directory has one, in which case the
+        missing manifest means an uncommitted (killed mid-save) write.
+        """
+        cands = self._candidates()
+        uses_manifests = any(
+            os.path.exists(os.path.join(p, MANIFEST_NAME)) for _, p in cands
+        )
+        for name, path in cands:
+            status, detail = verify_manifest(path)
+            if status == "corrupt" or (
+                status == "unverified" and uses_manifests
+            ):
+                self._log(
+                    "ROKO_GUARD event=ckpt_corrupt "
+                    f"checkpoint={path} detail={detail!r} action=fallback"
+                )
+                continue
+            cand_like = like
+            if template is not None:
+                keys = self._keys_at(name)
+                cand_like = {k: v for k, v in template.items() if k in keys}
+            try:
+                return self._restore_at(name, cand_like)
+            except Exception as e:  # restore blew up on a "verified" dir
+                self._log(
+                    "ROKO_GUARD event=ckpt_restore_failed "
+                    f"checkpoint={path} error={e!r} action=fallback"
+                )
+                continue
+        if cands:
+            raise CheckpointIntegrityError(
+                f"checkpoints exist under {self.directory} but none "
+                "verifies/restores; refusing to silently train from "
+                "scratch over corrupt state (inspect or delete the "
+                "directory to restart)"
+            )
+        return None
 
     def restore_best(self, like=None) -> Optional[Dict[str, Any]]:
-        return self._restore(self._mgr.best_step(), like)
+        step = self._mgr.best_step()
+        if step is None:
+            return None
+        path = self._step_path(int(step))
+        status, detail = verify_manifest(path)
+        if status == "unverified" and any(
+            os.path.exists(os.path.join(p, MANIFEST_NAME))
+            for _, p in self._candidates()
+        ):
+            # same rule as restore_latest: no manifest in a directory
+            # where siblings have one means the commit was interrupted —
+            # the best-k artifact ships to inference, so refuse loudly
+            # rather than restore an unchecked write
+            status, detail = "corrupt", "no committed manifest"
+        if status == "corrupt":
+            raise CheckpointIntegrityError(
+                f"best checkpoint {path} fails verification ({detail})"
+            )
+        return self._restore_at(int(step), like)
 
     def latest_keys(self) -> Optional[set]:
         """Top-level key names of the most recent checkpoint (the
         ``latest`` dir if present, else the newest numbered step), or
-        None when no checkpoint exists. Resume builds its restore
-        target from the on-disk layout instead of guessing layouts via
-        exception handling (ADVICE r1 (a))."""
-        if os.path.exists(self._latest_path):
-            self._ckptr.wait_until_finished()
-            meta = self._ckptr.metadata(self._latest_path)
-            # orbax < 0.7 wrapped the tree (meta.item_metadata.tree);
-            # 0.7 returns the metadata tree itself as a plain dict.
-            # Two separate getattr steps: the fallback at each level
-            # must be the value from the level above, not the original
-            # wrapper, or an item_metadata-without-tree shape resolves
-            # back to the wrapper and .keys() explodes
-            inner = getattr(meta, "item_metadata", meta)
-            tree = getattr(inner, "tree", inner)
-            return set(tree.keys())
-        step = self._mgr.latest_step()
-        if step is None:
+        None when no checkpoint exists."""
+        cands = self._candidates()
+        if not cands:
             return None
-        meta = self._mgr.item_metadata(step)
-        inner = getattr(meta, "item_metadata", meta)
-        tree = getattr(inner, "tree", inner)
-        return set(tree.keys())
+        return self._keys_at(cands[0][0])
+
+    def has_checkpoint(self) -> bool:
+        return bool(self._candidates())
 
     def best_step(self) -> Optional[int]:
         return self._mgr.best_step()
@@ -156,6 +412,11 @@ def load_params(path: str) -> Dict[str, Any]:
             if state is None:
                 raise FileNotFoundError(f"no checkpoints under {path}")
             return _tuplify(state["params"])
+    status, detail = verify_manifest(path)
+    if status == "corrupt":
+        raise CheckpointIntegrityError(
+            f"saved state {path} fails verification ({detail})"
+        )
     ckptr = ocp.StandardCheckpointer()
     state = ckptr.restore(path)
     return _tuplify(state["params"] if "params" in state else state)
@@ -165,3 +426,5 @@ def save_params(path: str, params: Dict[str, Any]) -> None:
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.abspath(path), {"params": params})
     ckptr.wait_until_finished()
+    if jax.process_index() == 0:
+        write_manifest(os.path.abspath(path))

@@ -67,16 +67,24 @@ class InMemoryDataset:
         rng: Optional[np.random.Generator] = None,
         drop_remainder: bool = False,
         pad_to: Optional[int] = None,
+        skip_batches: int = 0,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         """Yield (x, y, weight) host batches.
 
         ``weight`` is 1.0 for real rows, 0.0 for padding rows added to
         reach ``pad_to`` (so sharded eval can use fixed batch shapes
         without biasing metrics).
+
+        ``skip_batches`` fast-forwards past the first k batches of the
+        SAME epoch stream (the full permutation is still drawn, so the
+        remaining batches are bit-identical to positions k.. of an
+        unskipped iteration) — step-granular resume replays an
+        interrupted epoch from exactly the next untrained batch
+        (docs/TRAINING.md).
         """
         n = len(self)
         order = rng.permutation(n) if rng is not None else np.arange(n)
-        for start in range(0, n, batch_size):
+        for start in range(skip_batches * batch_size, n, batch_size):
             idx = order[start : start + batch_size]
             if len(idx) < batch_size:
                 if drop_remainder:

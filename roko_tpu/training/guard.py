@@ -1,0 +1,210 @@
+"""NaN/loss-spike sentinel for the train loop (docs/TRAINING.md
+"Failure handling (training)").
+
+A single non-finite loss or gradient silently poisons every parameter it
+touches — Adam moments keep the NaN alive even if later batches are
+clean — and a pathological batch can spike the loss hard enough to wreck
+a mostly-converged run. The sentinel watches per-step host scalars the
+guarded train step returns (loss, gradient-finiteness; see
+``loop.make_guarded_train_step``) and decides BEFORE the optimizer
+update is dispatched:
+
+- non-finite loss/grads, or a loss further than ``spike_sigma`` EMA
+  standard deviations above the loss EMA → the update is *skipped*
+  (params and optimizer state untouched — donation-safe because the
+  apply step is simply never dispatched);
+- ``max_bad_steps`` consecutive skips → :class:`RollbackRequested`, and
+  the epoch driver restores the last good checkpoint with a re-jittered
+  dropout RNG stream (a transient fault replays differently; a
+  deterministic one hits ``max_rollbacks`` and aborts loudly).
+
+Every event is one structured ``ROKO_GUARD`` line (``event=skip``,
+``event=rollback``, ``event=param_nonfinite``, plus the checkpoint
+integrity chain's ``event=ckpt_corrupt`` from
+``roko_tpu/training/checkpoint.py``) so a log scrape sees the whole
+failure-handling story with one grep. This module is host-side only —
+the device-side flags are produced in ``loop.py``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict
+
+from roko_tpu.config import GuardConfig
+
+#: prefix of every structured sentinel/integrity log line
+GUARD_PREFIX = "ROKO_GUARD"
+
+
+def guard_line(event: str, **fields) -> str:
+    """One structured log line: ``ROKO_GUARD event=... k=v ...``.
+    Floats are compacted; key order follows the call site."""
+    parts = [f"{GUARD_PREFIX} event={event}"]
+    for k, v in fields.items():
+        if isinstance(v, float):
+            v = f"{v:.6g}"
+        parts.append(f"{k}={v}")
+    return " ".join(parts)
+
+
+class RollbackRequested(RuntimeError):
+    """Raised by :class:`TrainGuard` when consecutive bad steps exhaust
+    ``max_bad_steps`` (or an applied update produced non-finite params).
+    The epoch driver catches it and rolls back to the last good
+    checkpoint."""
+
+    def __init__(self, reason: str, step: int):
+        super().__init__(
+            f"guard requested rollback at step {step} (reason: {reason})"
+        )
+        self.reason = reason
+        self.step = step
+
+
+class TrainGuard:
+    """Host-side sentinel state: loss EMA + variance EMA, consecutive-bad
+    counter, event counters, ROKO_GUARD logging.
+
+    Decisions are pure functions of replicated device scalars every
+    process sees identically, so on a multi-host pod all processes skip
+    (or roll back) in lockstep without any extra collective.
+    """
+
+    def __init__(self, cfg: GuardConfig, log: Callable[[str], None] = print):
+        self.cfg = cfg
+        self._log = log
+        self.ema: float | None = None
+        self.var = 0.0
+        self.good_steps = 0
+        self.consecutive_bad = 0
+        self.counters: Dict[str, int] = {
+            "skipped_nonfinite": 0,
+            "skipped_spike": 0,
+            "param_nonfinite": 0,
+            "rollbacks": 0,
+        }
+
+    # -- decision --------------------------------------------------------
+
+    def spike_threshold(self) -> float | None:
+        """Loss level above which a step is a spike, or None while the
+        EMA is still warming up. The variance EMA starts at zero and
+        with decay beta has only accumulated ``1 - beta^n`` of the true
+        variance after n updates — without the Adam-style bias
+        correction an early threshold would sit ~sqrt(1-beta^n) too
+        tight and flag ordinary noise as spikes."""
+        if self.ema is None or self.good_steps < self.cfg.warmup_steps:
+            return None
+        updates = max(self.good_steps - 1, 1)  # first good step sets ema only
+        bias = max(1.0 - self.cfg.ema_beta ** updates, 1e-12)
+        return self.ema + self.cfg.spike_sigma * max(
+            math.sqrt(self.var / bias), 1e-8
+        )
+
+    def check(self, step: int, loss: float, grads_finite: bool) -> bool:
+        """Classify one step. Returns True when the update should be
+        applied; False to skip it. Raises :class:`RollbackRequested`
+        after ``max_bad_steps`` consecutive skips."""
+        reason = None
+        if not grads_finite or not math.isfinite(loss):
+            reason = "nonfinite"
+        else:
+            threshold = self.spike_threshold()
+            if threshold is not None and loss > threshold:
+                reason = "spike"
+        if reason is None:
+            if self.ema is None:
+                self.ema = loss
+            else:
+                beta = self.cfg.ema_beta
+                prev = self.ema
+                self.ema = beta * prev + (1.0 - beta) * loss
+                self.var = beta * self.var + (1.0 - beta) * (loss - prev) ** 2
+            self.good_steps += 1
+            self.consecutive_bad = 0
+            return True
+
+        self.consecutive_bad += 1
+        self.counters[f"skipped_{reason}"] += 1
+        self._log(
+            guard_line(
+                "skip",
+                reason=reason,
+                step=step,
+                loss=loss,
+                ema=self.ema if self.ema is not None else float("nan"),
+                consecutive=self.consecutive_bad,
+                max_bad_steps=self.cfg.max_bad_steps,
+            )
+        )
+        if self.consecutive_bad >= self.cfg.max_bad_steps:
+            raise RollbackRequested(reason, step)
+        return False
+
+    def params_nonfinite(self, step: int) -> None:
+        """An APPLIED update produced non-finite params (overflow in the
+        optimizer math despite finite grads). The old params were donated
+        — skipping cannot help, so this rolls back immediately."""
+        self.counters["param_nonfinite"] += 1
+        self._log(guard_line("param_nonfinite", step=step, action="rollback"))
+        raise RollbackRequested("param_nonfinite", step)
+
+    # -- checkpoint round-trip ------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Sentinel stream state for the checkpoint's ``data_state`` —
+        a killed-and-resumed run must make the SAME skip/rollback
+        decisions an uninterrupted one would (EMA armed at the same
+        step, consecutive-bad count surviving a kill between bad
+        steps). Event counters are per-process reporting and are not
+        persisted. Floats are stored as f32, so decisions are
+        resume-stable to f32 precision of the thresholds."""
+        return {
+            "ema": self.ema if self.ema is not None else float("nan"),
+            "var": self.var,
+            "good_steps": self.good_steps,
+            "consecutive_bad": self.consecutive_bad,
+        }
+
+    def load_state(self, state: Dict[str, Any]) -> None:
+        ema = float(state["ema"])
+        self.ema = None if math.isnan(ema) else ema
+        self.var = float(state["var"])
+        self.good_steps = int(state["good_steps"])
+        self.consecutive_bad = int(state["consecutive_bad"])
+
+    # -- rollback bookkeeping -------------------------------------------
+
+    def note_rollback(self) -> None:
+        """Reset per-stream state after the driver rolled back: the EMA
+        restarts from the restored trajectory (mixing pre-fault history
+        into post-restore losses would mis-arm the spike detector)."""
+        self.counters["rollbacks"] += 1
+        self.consecutive_bad = 0
+        self.ema = None
+        self.var = 0.0
+        self.good_steps = 0
+
+    # -- reporting -------------------------------------------------------
+
+    @property
+    def skipped(self) -> int:
+        return (
+            self.counters["skipped_nonfinite"] + self.counters["skipped_spike"]
+        )
+
+    @property
+    def events(self) -> int:
+        return self.skipped + self.counters["param_nonfinite"] + self.counters[
+            "rollbacks"
+        ]
+
+    def summary(self) -> str:
+        c = self.counters
+        return (
+            f"guard: skipped={self.skipped} "
+            f"(nonfinite={c['skipped_nonfinite']} spike={c['skipped_spike']}) "
+            f"param_nonfinite={c['param_nonfinite']} "
+            f"rollbacks={c['rollbacks']}"
+        )

@@ -79,8 +79,38 @@ class StreamingDataset:
         rng: Optional[np.random.Generator] = None,
         drop_remainder: bool = False,
         pad_to: Optional[int] = None,
+        skip_batches: int = 0,
     ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
-        """Same contract as InMemoryDataset.batches: yields (x, y, w)."""
+        """Same contract as InMemoryDataset.batches: yields (x, y, w).
+
+        ``skip_batches`` fast-forwards the stream by generating and
+        discarding the first k batches: the chunk order and every
+        shuffle-buffer permutation consume the RNG identically to an
+        unskipped epoch, so the surviving batches are bit-identical to
+        positions k.. — the price is re-reading the skipped prefix from
+        HDF5 (sequential chunk reads, so a resume fast-forward streams
+        at disk speed)."""
+        import itertools
+
+        yield from itertools.islice(
+            self._batches_impl(
+                batch_size,
+                rng=rng,
+                drop_remainder=drop_remainder,
+                pad_to=pad_to,
+            ),
+            skip_batches,
+            None,
+        )
+
+    def _batches_impl(
+        self,
+        batch_size: int,
+        *,
+        rng: Optional[np.random.Generator] = None,
+        drop_remainder: bool = False,
+        pad_to: Optional[int] = None,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         buf_x: List[np.ndarray] = []
         buf_y: List[np.ndarray] = []
         held = 0

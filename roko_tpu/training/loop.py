@@ -79,6 +79,22 @@ def _loss_and_stats(model, params, x, y, w, rng):
     return loss, (correct, total)
 
 
+def _donate_state_argnums(mesh: Mesh, argnums: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Donate params/opt_state buffers only on a pure-dp mesh. With a
+    tensor- or sequence-parallel axis the inputs carry committed
+    NamedShardings while the step's out_shardings stay None (XLA's
+    choice), and this jaxlib crashes at dispatch trying to alias the
+    mismatched layouts (INTERNAL "Expected aliased input ... to have the
+    same size") instead of quietly dropping the donation. Donation never
+    bought anything there anyway — the same runs warned "donated buffers
+    were not usable" on older jaxlibs."""
+    from roko_tpu.parallel.mesh import AXIS_SP, AXIS_TP
+
+    if mesh.shape.get(AXIS_TP, 1) > 1 or mesh.shape.get(AXIS_SP, 1) > 1:
+        return ()
+    return argnums
+
+
 def make_train_step(
     model: RokoModel, tx: optax.GradientTransformation, mesh: Mesh
 ) -> Callable:
@@ -93,7 +109,7 @@ def make_train_step(
         jax.jit,
         in_shardings=(None, None, repl, data, data, data, repl),
         out_shardings=(None, None, repl, repl),
-        donate_argnums=(0, 1),
+        donate_argnums=_donate_state_argnums(mesh, (0, 1)),
     )
     def step(params, opt_state, step_no, x, y, w, rng):
         rng = jax.random.fold_in(rng, step_no)
@@ -110,6 +126,70 @@ def make_train_step(
         return params, opt_state, loss, correct / jnp.maximum(total, 1.0)
 
     return step
+
+
+def make_guarded_train_step(
+    model: RokoModel, tx: optax.GradientTransformation, mesh: Mesh
+) -> Tuple[Callable, Callable]:
+    """Two-phase train step for the NaN/loss-spike sentinel
+    (roko_tpu/training/guard.py): ``grad_step`` computes grads plus
+    host-checkable flags WITHOUT donating or touching params, the host
+    decides (TrainGuard.check), and only a good step re-dispatches
+    ``apply_step`` — which donates params/opt_state/grads exactly like
+    the fused step. A bad step simply never dispatches the apply, so the
+    pre-step params survive untouched; deciding after a fused donating
+    step would be too late, the old buffers are already gone.
+
+    Returns ``(grad_step, apply_step)``:
+
+    - ``grad_step(params, step_no, x, y, w, rng) ->
+      (grads, loss, acc, grads_finite)`` — ``grads_finite`` is a
+      replicated bool covering the loss and every gradient leaf;
+    - ``apply_step(params, opt_state, grads) ->
+      (params, opt_state, params_finite)`` — ``params_finite`` catches
+      optimizer-math overflow (finite grads, non-finite update).
+    """
+    repl = replicated_sharding(mesh)
+    data = data_sharding(mesh)
+
+    @partial(
+        jax.jit,
+        in_shardings=(None, repl, data, data, data, repl),
+        out_shardings=(None, repl, repl, repl),
+    )
+    def grad_step(params, step_no, x, y, w, rng):
+        rng = jax.random.fold_in(rng, step_no)
+
+        def loss_fn(p):
+            loss, aux = _loss_and_stats(model, p, x, y, w, rng)
+            return loss, aux
+
+        (loss, (correct, total)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        finite = jnp.isfinite(loss)
+        for leaf in jax.tree.leaves(grads):
+            finite = jnp.logical_and(finite, jnp.isfinite(leaf).all())
+        return grads, loss, correct / jnp.maximum(total, 1.0), finite
+
+    # donate params/opt_state only, exactly like the fused step: the
+    # outputs can reuse at most params+opt_state worth of buffers, so a
+    # donated grads tree would just trip the unusable-donation warning
+    @partial(
+        jax.jit,
+        in_shardings=(None, None, None),
+        out_shardings=(None, None, repl),
+        donate_argnums=_donate_state_argnums(mesh, (0, 1)),
+    )
+    def apply_step(params, opt_state, grads):
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        finite = jnp.asarray(True)
+        for leaf in jax.tree.leaves(params):
+            finite = jnp.logical_and(finite, jnp.isfinite(leaf).all())
+        return params, opt_state, finite
+
+    return grad_step, apply_step
 
 
 def make_eval_step(model: RokoModel, mesh: Mesh) -> Callable:
@@ -222,9 +302,17 @@ def train(
     """Full training run; returns the final state. Best-k checkpoints by
     validation accuracy land in ``out_dir`` (ref flow: roko/train.py:18-111).
 
-    Checkpoints carry optimizer state, step, epoch and the
-    early-stopping counters, so an interrupted run resumes exactly (the
-    reference had no resume at all, SURVEY.md §5.3-5.4).
+    Checkpoints carry optimizer state, step, epoch, the early-stopping
+    counters, AND the data-pipeline position (epoch, batch index,
+    applied-update count, running loss sum) so an interrupted run
+    resumes from exactly the next untrained batch and finishes with
+    bit-identical params and loss curve to an uninterrupted run
+    (docs/TRAINING.md "Failure handling"); every save commits a sha256
+    manifest and restore walks a verified fallback chain
+    (checkpoint.py). With ``cfg.guard.enabled`` the NaN/loss-spike
+    sentinel (guard.py) skips bad updates and rolls back to the last
+    good checkpoint — with a re-jittered dropout stream — after
+    ``max_bad_steps`` consecutive bad steps.
 
     Multi-host pods: call-site needs nothing special — ``train()``
     initialises ``jax.distributed`` when a pod topology is detected, the
@@ -233,13 +321,16 @@ def train(
     process participates in checkpoint save/restore (the Orbax
     multi-host contract: process 0 writes metadata, all processes write
     their addressable shards — gating save on the primary would
-    deadlock sharded arrays)."""
+    deadlock sharded arrays). Guard decisions read replicated scalars,
+    so every process skips/rolls back in lockstep."""
     from roko_tpu.parallel import distributed
+    from roko_tpu.training import guard as guard_lib
 
     distributed.initialize()  # no-op single host (SURVEY §5.8)
     if not distributed.is_primary():
         log = lambda s: None  # noqa: E731 — primary-only logging
     tcfg = cfg.train
+    gcfg = cfg.guard
     mesh = mesh or make_mesh(cfg.mesh)
     dp = mesh.shape[AXIS_DP]
     if tcfg.batch_size % dp:
@@ -281,64 +372,15 @@ def train(
         dropout_rng = jax.random.key(
             tcfg.seed + 1, impl=tcfg.dropout_rng_impl
         )
-    state = create_state(model, tx, init_rng)
-    state = TrainState(
-        put_replicated(state.params, mesh),
-        put_replicated(state.opt_state, mesh),
-        state.step,
-    )
 
-    train_step = make_train_step(model, tx, mesh)
     eval_step = make_eval_step(model, mesh)
     place = make_placer(mesh)
+    steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
 
-    manager = ckpt_lib.CheckpointManager(out_dir, keep=tcfg.keep_checkpoints)
-    best_acc, bad_epochs = -1.0, 0
-    params, opt_state, step_no = state.params, state.opt_state, state.step
-
-    # the saved state carries the epoch and early-stopping counters
-    # explicitly — deriving the epoch from step // steps_per_epoch would
-    # break on resume with a different batch size or dataset, and a
-    # resume that forgot best_acc/bad_epochs would silently reset the
-    # patience window (ADVICE r1 (b))
-    full_template = dict(
-        state.as_dict(),
-        epoch=jnp.zeros((), jnp.int32),
-        early_stop={
-            "best_acc": jnp.zeros((), jnp.float32),
-            "bad_epochs": jnp.zeros((), jnp.int32),
-        },
+    manager = ckpt_lib.CheckpointManager(
+        out_dir, keep=tcfg.keep_checkpoints, log=log
     )
-    start_epoch = 0
-    if resume:
-        # build the restore target from the checkpoint's actual on-disk
-        # layout (older layouts lack 'epoch'/'early_stop') — a corrupt
-        # checkpoint now raises instead of being mistaken for a legacy
-        # layout (ADVICE r1 (a))
-        keys = manager.latest_keys()
-        if keys is not None:
-            like = {k: v for k, v in full_template.items() if k in keys}
-            restored = manager.restore_latest(like=like)
-        else:
-            restored = None
-        if restored is not None:
-            params = put_replicated(restored["params"], mesh)
-            opt_state = put_replicated(restored["opt_state"], mesh)
-            step_no = jnp.asarray(restored["step"], jnp.int32)
-            if "epoch" in restored:
-                start_epoch = int(jax.device_get(restored["epoch"])) + 1
-            else:  # pre-'epoch' layout: recover from the step count
-                steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
-                start_epoch = int(restored["step"]) // steps_per_epoch
-            if "early_stop" in restored:
-                es = jax.device_get(restored["early_stop"])
-                best_acc = float(es["best_acc"])
-                bad_epochs = int(es["bad_epochs"])
-            log(
-                f"resumed from step {int(jax.device_get(step_no))} "
-                f"(epoch {start_epoch}, best val_acc {best_acc:.5f}, "
-                f"{bad_epochs} stale epochs)"
-            )
+    guard = guard_lib.TrainGuard(gcfg, log) if gcfg.enabled else None
 
     if val_ds is None:
         # train-set accuracy is near-monotonic, so patience would never
@@ -346,13 +388,164 @@ def train(
         # (VERDICT r2 weak #4)
         log("no val set: early stopping disabled, running all epochs")
 
-    steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
-    try:
+    def _run(attempt: int) -> TrainState:
+        # jitted steps are built per attempt — a fresh trace after a
+        # rollback (rollbacks are rare; the recompile is noise next to
+        # the restore) — and the dropout stream is re-jittered so a
+        # transient mask-dependent fault doesn't replay identically
+        if guard is not None:
+            grad_step, apply_step = make_guarded_train_step(model, tx, mesh)
+            train_step = None
+        else:
+            train_step = make_train_step(model, tx, mesh)
+
+        state = create_state(model, tx, init_rng)
+        state = TrainState(
+            put_replicated(state.params, mesh),
+            put_replicated(state.opt_state, mesh),
+            state.step,
+        )
+        params, opt_state, step_no = state.params, state.opt_state, state.step
+        best_acc, bad_epochs = -1.0, 0
+        start_epoch, start_batch, start_applied = 0, 0, 0
+        running0 = np.float32(0.0)
+        persisted_rollbacks = 0
+
+        # the saved state carries the epoch, early-stopping counters and
+        # data position explicitly — deriving the epoch from
+        # step // steps_per_epoch would break on resume with a different
+        # batch size or dataset, and a resume that forgot
+        # best_acc/bad_epochs would silently reset the patience window
+        # (ADVICE r1 (b))
+        full_template = dict(
+            state.as_dict(),
+            epoch=jnp.zeros((), jnp.int32),
+            early_stop={
+                "best_acc": jnp.zeros((), jnp.float32),
+                "bad_epochs": jnp.zeros((), jnp.int32),
+            },
+            data_state={
+                "epoch": jnp.zeros((), jnp.int32),
+                "batch": jnp.zeros((), jnp.int32),
+                "applied": jnp.zeros((), jnp.int32),
+                "loss_sum": jnp.zeros((), jnp.float32),
+                # sentinel stream state rides along so a killed-and-
+                # resumed run makes the same skip/rollback decisions an
+                # uninterrupted one would (guard.state_dict)
+                "guard": {
+                    "ema": jnp.zeros((), jnp.float32),
+                    "var": jnp.zeros((), jnp.float32),
+                    "good_steps": jnp.zeros((), jnp.int32),
+                    "consecutive_bad": jnp.zeros((), jnp.int32),
+                    "rollbacks": jnp.zeros((), jnp.int32),
+                },
+            },
+        )
+        if resume or attempt > 0:
+            # the restore target is built per candidate from its actual
+            # on-disk keys (older layouts lack 'epoch'/'early_stop'/
+            # 'data_state'), and each candidate is verified against its
+            # integrity manifest with fallback to the next older good
+            # checkpoint (ADVICE r1 (a); checkpoint.py)
+            restored = manager.restore_latest(template=full_template)
+            if restored is not None:
+                params = put_replicated(restored["params"], mesh)
+                opt_state = put_replicated(restored["opt_state"], mesh)
+                step_no = jnp.asarray(restored["step"], jnp.int32)
+                if "data_state" in restored:
+                    dstate = jax.device_get(restored["data_state"])
+                    start_epoch = int(dstate["epoch"])
+                    start_batch = int(dstate["batch"])
+                    start_applied = int(dstate["applied"])
+                    running0 = np.float32(dstate["loss_sum"])
+                    gstate = dstate.get("guard")
+                    if gstate is not None:
+                        persisted_rollbacks = int(gstate["rollbacks"])
+                        if guard is not None:
+                            guard.load_state(gstate)
+                elif "epoch" in restored:
+                    start_epoch = int(jax.device_get(restored["epoch"])) + 1
+                else:  # pre-'epoch' layout: recover from the step count
+                    start_epoch = int(restored["step"]) // steps_per_epoch
+                if "early_stop" in restored:
+                    es = jax.device_get(restored["early_stop"])
+                    best_acc = float(es["best_acc"])
+                    bad_epochs = int(es["bad_epochs"])
+                log(
+                    f"resumed from step {int(jax.device_get(step_no))} "
+                    f"(epoch {start_epoch}, batch {start_batch}, "
+                    f"best val_acc {best_acc:.5f}, "
+                    f"{bad_epochs} stale epochs)"
+                )
+        # dropout-stream jitter = persisted rollback count + in-process
+        # rollbacks: monotone across rollbacks (a transient fault replays
+        # on a fresh mask stream) and stable across kill+resume (the
+        # resumed process picks up the stream the killed attempt used)
+        jitter = persisted_rollbacks + attempt
+        drop_rng = (
+            dropout_rng
+            if jitter == 0
+            else jax.random.fold_in(dropout_rng, jitter)
+        )
+        hstep = int(jax.device_get(step_no))
+
+        def _guard_state():
+            g = (
+                guard.state_dict()
+                if guard is not None
+                else {
+                    "ema": float("nan"),
+                    "var": 0.0,
+                    "good_steps": 0,
+                    "consecutive_bad": 0,
+                }
+            )
+            return {
+                "ema": np.asarray(g["ema"], np.float32),
+                "var": np.asarray(g["var"], np.float32),
+                "good_steps": np.asarray(g["good_steps"], np.int32),
+                "consecutive_bad": np.asarray(
+                    g["consecutive_bad"], np.int32
+                ),
+                "rollbacks": np.asarray(jitter, np.int32),
+            }
+
+        def _save_mid(epoch, n_batches, n_applied, running):
+            # mid-epoch, latest-only checkpoint carrying the data
+            # position; scalar bookkeeping must be globally-replicated
+            # arrays (orbax refuses host-local jax.Arrays on a pod)
+            extras = put_replicated(
+                {
+                    "step": np.asarray(hstep, np.int32),
+                    # 'epoch' stays "last completed" for legacy readers
+                    "epoch": np.asarray(epoch - 1, np.int32),
+                    "early_stop": {
+                        "best_acc": np.asarray(best_acc, np.float32),
+                        "bad_epochs": np.asarray(bad_epochs, np.int32),
+                    },
+                    "data_state": {
+                        "epoch": np.asarray(epoch, np.int32),
+                        "batch": np.asarray(n_batches, np.int32),
+                        "applied": np.asarray(n_applied, np.int32),
+                        "loss_sum": np.asarray(
+                            jax.device_get(running), np.float32
+                        ),
+                        "guard": _guard_state(),
+                    },
+                },
+                mesh,
+            )
+            manager.save_latest(
+                {"params": params, "opt_state": opt_state, **extras}
+            )
+
         for epoch in range(start_epoch, tcfg.epochs):
             t0 = time.perf_counter()
+            skip = start_batch if epoch == start_epoch else 0
             # per-epoch derived RNG: epoch E shuffles identically whether
-            # or not the run was interrupted before it, for both the
-            # in-memory and streaming datasets (no replay bookkeeping)
+            # or not the run was interrupted before (or inside) it, for
+            # both the in-memory and streaming datasets; a mid-epoch
+            # resume fast-forwards the SAME stream to batch `skip`
             np_rng = np.random.default_rng(
                 np.random.SeedSequence([tcfg.seed, epoch])
             )
@@ -360,44 +553,94 @@ def train(
             # it: fixed shapes for XLA, but every window trains (the
             # reference's DataLoader also kept the last partial batch)
             batches = train_ds.batches(
-                tcfg.batch_size, rng=np_rng, pad_to=tcfg.batch_size
+                tcfg.batch_size,
+                rng=np_rng,
+                pad_to=tcfg.batch_size,
+                skip_batches=skip,
             )
-            # loss accumulates on device; one host transfer per epoch so
-            # dispatch never blocks on a per-step float()
-            running = jnp.zeros((), jnp.float32)
-            n_batches = 0
+            # loss accumulates on device in f32 (one chain of adds in
+            # batch order — the property the bit-identical resumed loss
+            # curve rests on); without the guard there is ONE host
+            # transfer per epoch so dispatch never blocks on a per-step
+            # float()
+            running = jnp.asarray(
+                running0 if epoch == start_epoch else 0.0, jnp.float32
+            )
+            n_batches = skip
+            n_applied = start_applied if epoch == start_epoch else 0
             # trace only the first trained epoch: a bounded window keeps
             # the profile loadable; a whole run would buffer every event
             trace = device_trace(trace_dir if epoch == start_epoch else None)
             with trace:
                 for x, y, w in prefetch_to_device(batches, tcfg.prefetch, place):
-                    params, opt_state, loss, _ = train_step(
-                        params, opt_state, step_no, x, y, w, dropout_rng
-                    )
+                    if guard is None:
+                        params, opt_state, loss, _ = train_step(
+                            params, opt_state, step_no, x, y, w, drop_rng
+                        )
+                        running = running + loss
+                        n_applied += 1
+                    else:
+                        # sentinel path: grads first (params untouched),
+                        # decide on host, re-dispatch the update only
+                        # for a good step — one host sync per step, the
+                        # price of the guard (docs/TRAINING.md)
+                        grads, loss, _, gfin = grad_step(
+                            params, step_no, x, y, w, drop_rng
+                        )
+                        good = guard.check(
+                            hstep,
+                            float(jax.device_get(loss)),
+                            bool(jax.device_get(gfin)),
+                        )
+                        if good:
+                            params, opt_state, pfin = apply_step(
+                                params, opt_state, grads
+                            )
+                            if not bool(jax.device_get(pfin)):
+                                guard.params_nonfinite(hstep)
+                            running = running + loss
+                            n_applied += 1
+                        else:
+                            del grads  # skip: params/opt_state untouched
                     step_no = step_no + 1
-                    running = running + loss
+                    hstep += 1
                     n_batches += 1
                     # in-epoch heartbeat: rate + ETA, no device sync (a
                     # float(loss) here would stall the dispatch queue)
                     if tcfg.log_every_steps and n_batches % tcfg.log_every_steps == 0:
                         dt_so_far = time.perf_counter() - t0
-                        rate = n_batches / max(dt_so_far, 1e-9)
+                        rate = (n_batches - skip) / max(dt_so_far, 1e-9)
                         eta = (steps_per_epoch - n_batches) / max(rate, 1e-9)
                         log(
                             f"  epoch {epoch} step {n_batches}/{steps_per_epoch} "
                             f"({rate * tcfg.batch_size:.0f} windows/s, "
                             f"eta {eta:.0f}s)"
                         )
-                running = float(jax.device_get(running))
+                    # (the epoch's final batch skips the mid save — the
+                    # epoch-boundary manager.save moments later would
+                    # immediately overwrite the same `latest` dir)
+                    if (
+                        gcfg.save_every_steps
+                        and n_batches % gcfg.save_every_steps == 0
+                        and n_batches < steps_per_epoch
+                    ):
+                        _save_mid(epoch, n_batches, n_applied, running)
+                running_h = float(jax.device_get(running))
             dt = time.perf_counter() - t0
 
             eval_ds = val_ds if val_ds is not None else train_ds
             acc, vloss = evaluate(eval_step, params, eval_ds, tcfg.batch_size, mesh)
+            guard_note = (
+                f" [{guard.summary()}]"
+                if guard is not None and guard.events
+                else ""
+            )
             log(
-                f"epoch {epoch}: train_loss {running / max(n_batches,1):.4f} "
+                f"epoch {epoch}: train_loss {running_h / max(n_applied,1):.4f} "
                 f"val_acc {acc:.5f} val_loss {vloss:.4f} "
                 f"({dt:.1f}s, {n_batches} steps, "
-                f"{n_batches * tcfg.batch_size / max(dt, 1e-9):.0f} windows/s)"
+                f"{(n_batches - skip) * tcfg.batch_size / max(dt, 1e-9):.0f} "
+                f"windows/s)" + guard_note
             )
 
             # update the patience window BEFORE saving so a resumed run
@@ -412,17 +655,26 @@ def train(
             # multi-host save
             extras = put_replicated(
                 {
-                    "step": np.asarray(jax.device_get(step_no), np.int32),
+                    "step": np.asarray(hstep, np.int32),
                     "epoch": np.asarray(epoch, np.int32),
                     "early_stop": {
                         "best_acc": np.asarray(best_acc, np.float32),
                         "bad_epochs": np.asarray(bad_epochs, np.int32),
                     },
+                    # epoch-boundary position: next epoch, batch 0 (the
+                    # sentinel stream still carries across epochs)
+                    "data_state": {
+                        "epoch": np.asarray(epoch + 1, np.int32),
+                        "batch": np.asarray(0, np.int32),
+                        "applied": np.asarray(0, np.int32),
+                        "loss_sum": np.asarray(0.0, np.float32),
+                        "guard": _guard_state(),
+                    },
                 },
                 mesh,
             )
             manager.save(
-                int(jax.device_get(step_no)),
+                hstep,
                 {
                     "params": params,
                     "opt_state": opt_state,
@@ -436,7 +688,39 @@ def train(
             if val_ds is not None and bad_epochs >= tcfg.patience:
                 log(f"early stop at epoch {epoch} (best val_acc {best_acc:.5f})")
                 break
+        if guard is not None and guard.events:
+            log(guard.summary())
+        return TrainState(params, opt_state, step_no)
+
+    attempt = 0
+    try:
+        while True:
+            try:
+                return _run(attempt)
+            except guard_lib.RollbackRequested as rb:
+                if not manager.has_checkpoint():
+                    raise RuntimeError(
+                        f"guard requested rollback ({rb.reason} at step "
+                        f"{rb.step}) but no checkpoint exists yet; cannot "
+                        "recover a run that failed before its first save"
+                    ) from rb
+                guard.note_rollback()
+                attempt += 1
+                if attempt > gcfg.max_rollbacks:
+                    raise RuntimeError(
+                        f"giving up after {gcfg.max_rollbacks} rollbacks "
+                        f"(last: {rb.reason} at step {rb.step}); the fault "
+                        "replays deterministically — inspect the data/"
+                        "config instead of rolling back again"
+                    ) from rb
+                log(
+                    guard_lib.guard_line(
+                        "rollback",
+                        reason=rb.reason,
+                        step=rb.step,
+                        rollbacks=attempt,
+                        max_rollbacks=gcfg.max_rollbacks,
+                    )
+                )
     finally:
         manager.close()
-
-    return TrainState(params, opt_state, step_no)

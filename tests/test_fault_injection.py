@@ -222,10 +222,16 @@ def test_train_survives_sigkill(tmp_path):
     ckpt_killed = str(tmp_path / "ckpt_killed")
     cmd = [_sys.executable, str(script), h5, ckpt_killed]
 
-    # run 1: SIGKILL as soon as epoch 1's summary line appears — the
-    # kill lands around epoch 1's checkpoint save / epoch 2's work, so
-    # the on-disk state may include a partially written (uncommitted)
-    # checkpoint the restart must cope with
+    # run 1: SIGKILL once epoch 1's checkpoint has actually COMMITTED —
+    # the summary line prints before the save, so killing on the line
+    # alone could land before the checkpoint finalises and leave nothing
+    # past epoch 0 to resume from (the old flake on a loaded box). The
+    # integrity manifest makes the commit observable: wait for epoch 1's
+    # step-8 manifest, then kill. The kill still lands around the
+    # `latest` rewrite / epoch 2's work, so the on-disk state may
+    # include an uncommitted checkpoint the restart must cope with.
+    import time
+
     proc = subprocess.Popen(
         cmd,
         stdout=subprocess.PIPE,
@@ -240,6 +246,13 @@ def test_train_survives_sigkill(tmp_path):
     for line in proc.stdout:
         child_lines.append(line)
         if line.startswith("epoch 1:"):
+            manifest = os.path.join(ckpt_killed, "8", "roko_manifest.json")
+            deadline = time.monotonic() + 300
+            while not os.path.exists(manifest) and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert os.path.exists(manifest), (
+                "epoch-1 checkpoint manifest never appeared"
+            )
             proc.kill()
             killed = True
             break
@@ -270,6 +283,278 @@ def test_train_survives_sigkill(tmp_path):
     train(cfg, h5, ckpt_clean, log=lambda *a: None)
 
     ma, mb = CheckpointManager(ckpt_killed), CheckpointManager(ckpt_clean)
+    try:
+        a, b = ma.restore_latest(), mb.restore_latest()
+    finally:
+        ma.close()
+        mb.close()
+    assert int(np.asarray(a["step"])) == int(np.asarray(b["step"]))
+    flat_a = jax.tree_util.tree_leaves_with_path(a["params"])
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(b["params"]))
+    assert flat_a and len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(flat_b[path]),
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged "
+            "across kill/resume",
+        )
+
+
+_CHILD_TRAIN_KILL_ON_COMMIT = """\
+import os, signal, sys
+
+sys.path.insert(0, {repo_root!r})
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from roko_tpu.config import (
+    GuardConfig, MeshConfig, ModelConfig, RokoConfig, TrainConfig,
+)
+from roko_tpu.training import checkpoint as ckpt_lib
+from roko_tpu.training.loop import train
+
+# SIGKILL self during the Nth checkpoint save, AFTER the orbax write but
+# BEFORE the manifest commit — the exact window a preemption/crash mid-
+# save leaves an uncommitted (unverifiable) checkpoint on disk
+kill_on = int(os.environ.get("ROKO_TEST_KILL_ON_COMMIT", "0"))
+_real_commit = ckpt_lib.CheckpointManager._commit_manifests
+_calls = dict(n=0)
+
+
+def _killing_commit(self, paths):
+    _calls["n"] += 1
+    if kill_on and _calls["n"] == kill_on:
+        os.kill(os.getpid(), signal.SIGKILL)
+    _real_commit(self, paths)
+
+
+ckpt_lib.CheckpointManager._commit_manifests = _killing_commit
+
+cfg = RokoConfig(
+    model=ModelConfig(
+        embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+    ),
+    train=TrainConfig(batch_size=16, epochs=4, lr=1e-2, in_memory=True),
+    mesh=MeshConfig(dp=8),
+)
+train(cfg, sys.argv[1], sys.argv[2], log=lambda m: print(m, flush=True))
+print("TRAIN_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_during_checkpoint_save_falls_back(tmp_path):
+    """SIGKILL delivered DURING a checkpoint save (after the orbax write,
+    before the manifest commit — the mid-save crash signature): the
+    newest checkpoint is left uncommitted, and ``--resume`` must detect
+    it via the integrity chain, log loudly, restore from the previous
+    GOOD checkpoint, and still finish with bit-identical final params
+    (the replay from the older checkpoint is deterministic)."""
+    import subprocess
+    import sys as _sys
+
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import MeshConfig, ModelConfig, RokoConfig, TrainConfig
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.training.loop import train
+
+    rng = np.random.default_rng(77)
+    X = rng.integers(
+        0, C.FEATURE_VOCAB, (64, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    h5 = str(tmp_path / "train.hdf5")
+    pos = [
+        np.stack([np.arange(C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1)
+    ] * len(X)
+    with DataWriter(h5, infer=False) as w:
+        w.write_contigs([("c", "ACGT" * 100)])
+        w.store("c", pos, list(X), list(Y))
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child_kill_commit.py"
+    script.write_text(_CHILD_TRAIN_KILL_ON_COMMIT.format(repo_root=repo_root))
+    ckpt = str(tmp_path / "ckpt_killed")
+    cmd = [_sys.executable, str(script), h5, ckpt]
+
+    # run 1: dies by its own SIGKILL inside epoch 1's save — epoch 0's
+    # checkpoint (step 4) is the last one with a committed manifest
+    env = dict(os.environ, ROKO_TEST_KILL_ON_COMMIT="2")
+    r1 = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=repo_root, env=env,
+        timeout=900,
+    )
+    assert r1.returncode == -9, r1.stdout + r1.stderr
+    assert not os.path.exists(os.path.join(ckpt, "8", "roko_manifest.json"))
+    assert os.path.exists(os.path.join(ckpt, "4", "roko_manifest.json"))
+
+    # run 2: same command, no kill — must skip the uncommitted
+    # checkpoints loudly and resume from step 4, then finish
+    env = dict(os.environ, ROKO_TEST_KILL_ON_COMMIT="0")
+    r2 = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=repo_root, env=env,
+        timeout=900,
+    )
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "TRAIN_DONE" in r2.stdout
+    assert "ROKO_GUARD event=ckpt_corrupt" in r2.stdout
+    assert "resumed from step 4 " in r2.stdout
+
+    # bit-identical to a never-interrupted run
+    cfg = RokoConfig(
+        model=ModelConfig(
+            embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+        ),
+        train=TrainConfig(batch_size=16, epochs=4, lr=1e-2, in_memory=True),
+        mesh=MeshConfig(dp=8),
+    )
+    ckpt_clean = str(tmp_path / "ckpt_clean")
+    train(cfg, h5, ckpt_clean, log=lambda *a: None)
+
+    from roko_tpu.training.checkpoint import CheckpointManager
+
+    ma, mb = CheckpointManager(ckpt), CheckpointManager(ckpt_clean)
+    try:
+        a, b = ma.restore_latest(), mb.restore_latest()
+    finally:
+        ma.close()
+        mb.close()
+    assert int(np.asarray(a["step"])) == int(np.asarray(b["step"]))
+    flat_a = jax.tree_util.tree_leaves_with_path(a["params"])
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(b["params"]))
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(flat_b[path]),
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged",
+        )
+
+
+_CHILD_TRAIN_STEP_GRANULAR = """\
+import sys
+
+sys.path.insert(0, {repo_root!r})
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from roko_tpu.config import (
+    GuardConfig, MeshConfig, ModelConfig, RokoConfig, TrainConfig,
+)
+from roko_tpu.training.loop import train
+
+cfg = RokoConfig(
+    model=ModelConfig(
+        embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+    ),
+    train=TrainConfig(
+        batch_size=16, epochs=3, lr=1e-2, in_memory=True, log_every_steps=1
+    ),
+    mesh=MeshConfig(dp=8),
+    guard=GuardConfig(save_every_steps=1),
+)
+train(cfg, sys.argv[1], sys.argv[2], log=lambda m: print(m, flush=True))
+print("TRAIN_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_mid_epoch_step_granular_resume(tmp_path):
+    """SIGKILL in the MIDDLE of an epoch with save_every_steps=1: the
+    restart resumes from the last committed mid-epoch checkpoint (not
+    the epoch boundary) and replays the remaining batches of the SAME
+    shuffle, finishing with bit-identical final params to a
+    never-interrupted run — an interruption now costs at most
+    save_every_steps batches, not a whole epoch."""
+    import subprocess
+    import sys as _sys
+
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import (
+        GuardConfig, MeshConfig, ModelConfig, RokoConfig, TrainConfig,
+    )
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.training.loop import train
+
+    rng = np.random.default_rng(78)
+    X = rng.integers(
+        0, C.FEATURE_VOCAB, (64, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    h5 = str(tmp_path / "train.hdf5")
+    pos = [
+        np.stack([np.arange(C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1)
+    ] * len(X)
+    with DataWriter(h5, infer=False) as w:
+        w.write_contigs([("c", "ACGT" * 100)])
+        w.store("c", pos, list(X), list(Y))
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child_step_granular.py"
+    script.write_text(_CHILD_TRAIN_STEP_GRANULAR.format(repo_root=repo_root))
+    ckpt = str(tmp_path / "ckpt_killed")
+    cmd = [_sys.executable, str(script), h5, ckpt]
+
+    # run 1: kill on the mid-epoch-1 heartbeat — step-granular saves
+    # (save_every_steps=1) mean SOME mid-epoch checkpoint has committed
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        bufsize=1,
+        cwd=repo_root,
+    )
+    killed = False
+    child_lines = []
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        child_lines.append(line)
+        if "epoch 1 step 2/4" in line:
+            proc.kill()
+            killed = True
+            break
+    proc.wait(timeout=60)
+    assert killed, (
+        "child exited before the kill landed; its output was:\n"
+        + "".join(child_lines[-30:])
+    )
+
+    # run 2: resumes (from a mid-epoch position unless the kill raced
+    # past an epoch boundary) and completes
+    done = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=repo_root, timeout=900
+    )
+    assert done.returncode == 0, done.stdout + done.stderr
+    assert "TRAIN_DONE" in done.stdout
+    assert "resumed from step" in done.stdout
+
+    # bit-identical to a never-interrupted run of the same config
+    cfg = RokoConfig(
+        model=ModelConfig(
+            embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+        ),
+        train=TrainConfig(
+            batch_size=16, epochs=3, lr=1e-2, in_memory=True,
+            log_every_steps=1,
+        ),
+        mesh=MeshConfig(dp=8),
+        guard=GuardConfig(save_every_steps=1),
+    )
+    ckpt_clean = str(tmp_path / "ckpt_clean")
+    train(cfg, h5, ckpt_clean, log=lambda *a: None)
+
+    from roko_tpu.training.checkpoint import CheckpointManager
+
+    ma, mb = CheckpointManager(ckpt), CheckpointManager(ckpt_clean)
     try:
         a, b = ma.restore_latest(), mb.restore_latest()
     finally:

@@ -1,0 +1,560 @@
+"""Bulletproof-training tests: checkpoint integrity chain, NaN/loss-spike
+sentinel with rollback, and step-granular deterministic resume
+(roko_tpu/training/guard.py + checkpoint.py + loop.py surgery,
+docs/TRAINING.md "Failure handling (training)").
+
+NaN injection rides the dropout RNG stream: the guarded grad step folds
+the dropout key with the step counter before calling ``_loss_and_stats``,
+so a monkeypatched wrapper can poison EXACT steps by comparing the folded
+key against precomputed values — and because a rollback re-jitters the
+stream, the same wrapper naturally demonstrates transient-fault recovery
+(the poison no longer matches after the rollback) without any host-side
+flag flipping. SIGKILL variants of these scenarios live in
+tests/test_fault_injection.py (subprocess, marked slow); everything here
+is in-process and tier-1."""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from roko_tpu import constants as C
+from roko_tpu.config import GuardConfig, MeshConfig, ModelConfig, RokoConfig, TrainConfig
+from roko_tpu.data.hdf5 import DataWriter
+from roko_tpu.training import loop
+from roko_tpu.training.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointIntegrityError,
+    CheckpointManager,
+    verify_manifest,
+    write_manifest,
+)
+from roko_tpu.training.guard import RollbackRequested, TrainGuard, guard_line
+from roko_tpu.training.loop import train
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+def _write_train_hdf5(path, rng, n=64):
+    X = rng.integers(
+        0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    pos = [
+        np.stack([np.arange(C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1)
+    ] * n
+    with DataWriter(str(path), infer=False) as w:
+        w.write_contigs([("c", "ACGT" * 100)])
+        w.store("c", pos, list(X), list(Y))
+    return X, Y
+
+
+def _cfg(guard=None, **train_kw):
+    kw = dict(batch_size=16, epochs=2, lr=1e-2)
+    kw.update(train_kw)
+    return RokoConfig(
+        model=TINY,
+        train=TrainConfig(**kw),
+        mesh=MeshConfig(dp=8),
+        guard=guard if guard is not None else GuardConfig(),
+    )
+
+
+def _poison_on_keys(bad_keys):
+    """A ``_loss_and_stats`` wrapper returning NaN loss whenever the
+    (step-folded) dropout key matches one of ``bad_keys``."""
+    real = loop._loss_and_stats
+
+    def poisoned(model, params, x, y, w, rng):
+        loss, aux = real(model, params, x, y, w, rng)
+        if rng is None:  # eval path: never poisoned
+            return loss, aux
+        hit = jnp.zeros((), jnp.bool_)
+        for key in bad_keys:
+            hit = jnp.logical_or(hit, (rng == key).all())
+        return jnp.where(hit, jnp.float32(jnp.nan), loss), aux
+
+    return poisoned
+
+
+def _dropout_rng(seed):
+    """The dropout key train() derives for TrainConfig(seed=seed)."""
+    _, dropout = jax.random.split(jax.random.PRNGKey(seed))
+    return dropout
+
+
+def _folded(dropout_rng, step):
+    return jax.random.fold_in(dropout_rng, jnp.asarray(step, jnp.int32))
+
+
+def _leaves(params):
+    return jax.tree_util.tree_leaves_with_path(jax.device_get(params))
+
+
+def _assert_params_equal(a, b):
+    fa, fb = _leaves(a), dict(_leaves(b))
+    assert fa and len(fa) == len(fb)
+    for path, leaf in fa:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(fb[path]),
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged",
+        )
+
+
+# -- host-side sentinel units -------------------------------------------
+
+
+def test_guard_line_format():
+    line = guard_line("skip", reason="nonfinite", step=7, loss=float("nan"))
+    assert line.startswith("ROKO_GUARD event=skip ")
+    assert "reason=nonfinite" in line and "step=7" in line and "loss=nan" in line
+
+
+def test_train_guard_nonfinite_and_rollback():
+    logs = []
+    g = TrainGuard(GuardConfig(max_bad_steps=3), logs.append)
+    assert g.check(0, 1.0, True)  # good
+    assert not g.check(1, float("nan"), True)
+    assert not g.check(2, 1.0, False)  # non-finite grads, finite loss
+    with pytest.raises(RollbackRequested) as ei:
+        g.check(3, float("inf"), True)
+    assert ei.value.reason == "nonfinite" and ei.value.step == 3
+    assert g.counters["skipped_nonfinite"] == 3
+    assert sum("event=skip" in l for l in logs) == 3
+    g.note_rollback()
+    assert g.consecutive_bad == 0 and g.counters["rollbacks"] == 1
+    assert "rollbacks=1" in g.summary()
+
+
+def test_train_guard_spike_detection():
+    cfg = GuardConfig(spike_sigma=4.0, ema_beta=0.9, warmup_steps=5)
+    logs = []
+    g = TrainGuard(cfg, logs.append)
+    rng = np.random.default_rng(0)
+    # stable noisy plateau around 2.0
+    for i in range(30):
+        assert g.check(i, 2.0 + 0.01 * rng.standard_normal(), True)
+    # a drop (improvement) is NOT a spike — detection is one-sided
+    assert g.check(30, 0.5, True)
+    # a big jump IS
+    assert not g.check(31, 10.0, True)
+    assert g.counters["skipped_spike"] == 1
+    assert any("reason=spike" in l for l in logs)
+    # good steps reset the consecutive counter
+    assert g.check(32, 2.0, True) and g.consecutive_bad == 0
+
+
+def test_train_guard_state_roundtrip():
+    """Sentinel stream state survives a checkpoint round-trip so a
+    resumed run makes the same decisions (same EMA arming step, same
+    consecutive-bad count) as an uninterrupted one."""
+    g = TrainGuard(GuardConfig(warmup_steps=2, max_bad_steps=5), lambda s: None)
+    for i in range(4):
+        g.check(i, 2.0 + 0.1 * i, True)
+    g.check(4, float("nan"), True)  # one bad step pending
+    snap = g.state_dict()
+    g2 = TrainGuard(GuardConfig(warmup_steps=2, max_bad_steps=5), lambda s: None)
+    # f32 round-trip, exactly as the checkpoint stores it
+    g2.load_state({k: np.float32(v) for k, v in snap.items()})
+    assert g2.good_steps == g.good_steps == 4
+    assert g2.consecutive_bad == 1
+    assert g2.ema == pytest.approx(g.ema, rel=1e-6)
+    assert g2.spike_threshold() == pytest.approx(g.spike_threshold(), rel=1e-5)
+    # a fresh (never-armed) guard round-trips its None EMA through nan
+    g3 = TrainGuard(GuardConfig(), lambda s: None)
+    g4 = TrainGuard(GuardConfig(), lambda s: None)
+    g4.load_state({k: np.float32(v) for k, v in g3.state_dict().items()})
+    assert g4.ema is None and g4.spike_threshold() is None
+
+
+def test_train_guard_spike_unarmed_during_warmup():
+    g = TrainGuard(GuardConfig(warmup_steps=10), lambda s: None)
+    for i in range(5):
+        assert g.check(i, 1.0, True)
+    # would be a flagrant spike post-warmup; EMA not armed yet
+    assert g.check(5, 1e6, True)
+
+
+# -- dataset fast-forward -----------------------------------------------
+
+
+def test_in_memory_skip_batches_identical(rng, tmp_path):
+    from roko_tpu.training.data import InMemoryDataset
+
+    X = rng.integers(0, 12, (40, 4, 6)).astype(np.uint8)
+    Y = (X.sum(axis=1) % 5).astype(np.int64)
+    ds = InMemoryDataset(X, Y)
+
+    def run(skip):
+        r = np.random.default_rng(np.random.SeedSequence([3, 0]))
+        return list(ds.batches(16, rng=r, pad_to=16, skip_batches=skip))
+
+    full, skipped = run(0), run(2)
+    assert len(skipped) == len(full) - 2
+    for (xa, ya, wa), (xb, yb, wb) in zip(full[2:], skipped):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+        np.testing.assert_array_equal(wa, wb)
+
+
+def test_streaming_skip_batches_identical(rng, tmp_path):
+    from roko_tpu.training.lazy_data import StreamingDataset
+
+    _write_train_hdf5(tmp_path / "t.hdf5", rng, n=48)
+    ds = StreamingDataset(str(tmp_path / "t.hdf5"), chunk_size=8, buffer_chunks=2)
+
+    def run(skip):
+        r = np.random.default_rng(np.random.SeedSequence([3, 1]))
+        return list(ds.batches(16, rng=r, pad_to=16, skip_batches=skip))
+
+    full, skipped = run(0), run(1)
+    assert len(skipped) == len(full) - 1
+    for (xa, ya, wa), (xb, yb, wb) in zip(full[1:], skipped):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+        np.testing.assert_array_equal(wa, wb)
+
+
+# -- config + CLI threading ---------------------------------------------
+
+
+def test_guard_config_json_roundtrip():
+    cfg = RokoConfig(
+        guard=GuardConfig(spike_sigma=4.5, max_bad_steps=7, enabled=False)
+    )
+    cfg2 = RokoConfig.from_json(cfg.to_json())
+    assert cfg2.guard == cfg.guard
+    # defaults survive an empty JSON section
+    assert RokoConfig.from_json("{}").guard == GuardConfig()
+
+
+def test_guard_cli_flags_layer_over_config(tmp_path):
+    from roko_tpu.cli import _build_config, build_parser
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(
+        RokoConfig(guard=GuardConfig(spike_sigma=3.0, ema_beta=0.5)).to_json()
+    )
+    args = build_parser().parse_args(
+        [
+            "train", "in.hdf5", "out",
+            "--config", str(cfg_path),
+            "--spike-sigma", "9.5",
+            "--max-bad-steps", "2",
+            "--max-rollbacks", "1",
+            "--guard-warmup-steps", "5",
+            "--save-every-steps", "11",
+        ]
+    )
+    guard = _build_config(args).guard
+    assert guard.spike_sigma == 9.5  # CLI wins
+    assert guard.ema_beta == 0.5  # config file survives
+    assert (guard.max_bad_steps, guard.max_rollbacks) == (2, 1)
+    assert guard.warmup_steps == 5 and guard.save_every_steps == 11
+    assert guard.enabled
+
+    args = build_parser().parse_args(["train", "in.hdf5", "out", "--no-guard"])
+    assert not _build_config(args).guard.enabled
+
+
+# -- integrity chain (manager-level) ------------------------------------
+
+
+def _corrupt(ckpt_dir):
+    """Flip a byte in the biggest payload file under ``ckpt_dir``."""
+    files = [
+        f
+        for f in glob.glob(os.path.join(ckpt_dir, "**"), recursive=True)
+        if os.path.isfile(f)
+        and not f.endswith(MANIFEST_NAME)
+        and os.path.getsize(f) > 0
+    ]
+    victim = max(files, key=os.path.getsize)
+    with open(victim, "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0xFF]))
+    return victim
+
+
+def test_manifest_written_and_verified(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(8, dtype=jnp.float32)},
+        "opt_state": {"m": jnp.zeros(8)},
+        "step": jnp.asarray(4, jnp.int32),
+    }
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), log=lambda s: None)
+    mgr.save(4, state, val_acc=0.5)
+    mgr.close()
+    for sub in ("4", "latest"):
+        path = str(tmp_path / "ckpt" / sub)
+        assert os.path.exists(os.path.join(path, MANIFEST_NAME))
+        status, detail = verify_manifest(path)
+        assert status == "ok", detail
+    # tamper -> corrupt with a named culprit
+    victim = _corrupt(str(tmp_path / "ckpt" / "latest"))
+    status, detail = verify_manifest(str(tmp_path / "ckpt" / "latest"))
+    assert status == "corrupt" and os.path.basename(victim) in detail
+    # truncation is called out as such
+    os.truncate(victim, 0)
+    status, detail = verify_manifest(str(tmp_path / "ckpt" / "latest"))
+    assert status == "corrupt" and "truncated" in detail
+
+
+def test_restore_fallback_chain_and_refusal(tmp_path):
+    def state(i):
+        return {
+            "params": {"w": jnp.full(8, float(i), jnp.float32)},
+            "opt_state": {"m": jnp.zeros(8)},
+            "step": jnp.asarray(i, jnp.int32),
+        }
+
+    logs = []
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), log=logs.append)
+    mgr.save(4, state(4), val_acc=0.4)
+    mgr.save(8, state(8), val_acc=0.5)
+
+    # healthy: latest (== step 8) restores
+    assert int(np.asarray(mgr.restore_latest()["step"])) == 8
+
+    # corrupt latest -> numbered step 8
+    _corrupt(str(tmp_path / "ckpt" / "latest"))
+    assert int(np.asarray(mgr.restore_latest()["step"])) == 8
+    assert any("event=ckpt_corrupt" in l and "latest" in l for l in logs)
+
+    # a manifest MISSING in a manifested dir means an uncommitted
+    # (killed mid-save) write -> also skipped
+    os.unlink(str(tmp_path / "ckpt" / "8" / MANIFEST_NAME))
+    assert int(np.asarray(mgr.restore_latest()["step"])) == 4
+    # restore_best applies the same uncommitted rule (step 8 is best by
+    # metric but its manifest commit was "interrupted"): loud refusal,
+    # not a silently unchecked restore of the artifact inference ships
+    with pytest.raises(CheckpointIntegrityError, match="verification"):
+        mgr.restore_best()
+
+    # nothing verifies -> loud refusal, never a silent fresh start
+    _corrupt(str(tmp_path / "ckpt" / "4"))
+    with pytest.raises(CheckpointIntegrityError, match="refusing"):
+        mgr.restore_latest()
+    mgr.close()
+
+
+def test_unverified_legacy_dir_still_restores(tmp_path):
+    """A pre-integrity checkpoint dir (no manifests anywhere) keeps
+    working — verification only turns strict once manifests exist."""
+    state = {
+        "params": {"w": jnp.arange(4, dtype=jnp.float32)},
+        "opt_state": {"m": jnp.zeros(4)},
+        "step": jnp.asarray(2, jnp.int32),
+    }
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), log=lambda s: None)
+    mgr.save(2, state, val_acc=0.5)
+    mgr.close()
+    for sub in os.listdir(tmp_path / "ckpt"):
+        manifest = tmp_path / "ckpt" / sub / MANIFEST_NAME
+        if manifest.exists():
+            os.unlink(manifest)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), log=lambda s: None)
+    restored = mgr.restore_latest()
+    mgr.close()
+    assert int(np.asarray(restored["step"])) == 2
+
+
+# -- sentinel end-to-end through train() --------------------------------
+
+
+def test_nan_batch_skipped_without_corrupting_params(rng, tmp_path, monkeypatch):
+    """One injected NaN batch: the update is skipped (ROKO_GUARD skip
+    line), training continues, final params are finite, and the step
+    budget still completes."""
+    _write_train_hdf5(tmp_path / "train.hdf5", rng)
+    drng = _dropout_rng(seed=0)
+    # poison exactly step 5 (epoch 1, 2nd batch; 4 steps/epoch)
+    monkeypatch.setattr(
+        loop, "_loss_and_stats", _poison_on_keys([_folded(drng, 5)])
+    )
+    logs = []
+    state = train(
+        _cfg(), str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+    skips = [l for l in logs if "ROKO_GUARD event=skip" in l]
+    assert len(skips) == 1 and "reason=nonfinite" in skips[0]
+    assert "step=5" in skips[0]
+    # the skipped batch still consumed a step slot
+    assert int(jax.device_get(state.step)) == 2 * 4
+    for _, leaf in _leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # counters surfaced in the epoch summary
+    assert any("guard: skipped=1" in l for l in logs)
+
+
+def test_consecutive_nans_roll_back_and_recover(rng, tmp_path, monkeypatch):
+    """max_bad_steps consecutive NaNs trigger a rollback to the last
+    good checkpoint; the re-jittered dropout stream no longer matches
+    the poisoned keys, so the replay is clean and the run completes
+    bit-identically to... well, finitely."""
+    _write_train_hdf5(tmp_path / "train.hdf5", rng)
+    drng = _dropout_rng(seed=0)
+    # poison steps 5 and 6 of the ORIGINAL stream: two consecutive bad
+    # steps in epoch 1, after epoch 0's checkpoint landed
+    bad = [_folded(drng, 5), _folded(drng, 6)]
+    monkeypatch.setattr(loop, "_loss_and_stats", _poison_on_keys(bad))
+    logs = []
+    guard_cfg = GuardConfig(max_bad_steps=2, max_rollbacks=2)
+    state = train(
+        _cfg(guard=guard_cfg), str(tmp_path / "train.hdf5"),
+        str(tmp_path / "ckpt"), log=logs.append,
+    )
+    rollbacks = [l for l in logs if "ROKO_GUARD event=rollback" in l]
+    assert len(rollbacks) == 1 and "rollbacks=1" in rollbacks[0]
+    # the rollback resumed from epoch 0's checkpoint (step 4)
+    assert any("resumed from step 4 " in l for l in logs)
+    assert int(jax.device_get(state.step)) == 2 * 4
+    for _, leaf in _leaves(state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+    assert any("rollbacks=1" in l and "guard:" in l for l in logs)
+
+
+def test_rollback_without_checkpoint_refuses(rng, tmp_path, monkeypatch):
+    """A run that goes bad before its FIRST save has nothing to roll
+    back to — it must abort loudly, not silently restart from scratch."""
+    _write_train_hdf5(tmp_path / "train.hdf5", rng, n=32)
+    drng = _dropout_rng(seed=0)
+    monkeypatch.setattr(
+        loop,
+        "_loss_and_stats",
+        _poison_on_keys([_folded(drng, 0), _folded(drng, 1)]),
+    )
+    with pytest.raises(RuntimeError, match="no checkpoint exists yet"):
+        train(
+            _cfg(guard=GuardConfig(max_bad_steps=2)),
+            str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+            log=lambda s: None,
+        )
+
+
+def test_persistent_fault_exhausts_rollbacks(rng, tmp_path, monkeypatch):
+    """A fault that survives the re-jittered replay (poison keys cover
+    the original AND every re-jittered dropout stream) keeps requesting
+    rollbacks; after max_rollbacks the run gives up loudly instead of
+    looping forever."""
+    _write_train_hdf5(tmp_path / "train.hdf5", rng)
+    base = _dropout_rng(seed=0)
+    bad = []
+    for attempt in range(3):  # attempt 0 + both retries
+        stream = base if attempt == 0 else jax.random.fold_in(base, attempt)
+        bad += [_folded(stream, 4), _folded(stream, 5)]
+    monkeypatch.setattr(loop, "_loss_and_stats", _poison_on_keys(bad))
+    logs = []
+    with pytest.raises(RuntimeError, match="giving up after 1 rollback"):
+        train(
+            _cfg(guard=GuardConfig(max_bad_steps=2, max_rollbacks=1),
+                 epochs=2),
+            str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+            log=logs.append,
+        )
+    assert any("ROKO_GUARD event=rollback" in l for l in logs)
+
+
+# -- step-granular deterministic resume ---------------------------------
+
+
+class _Interrupt(Exception):
+    pass
+
+
+def test_mid_epoch_interrupt_resumes_bit_identical(rng, tmp_path):
+    """The acceptance contract: a run interrupted mid-epoch and resumed
+    produces a bit-identical loss curve and final params to an
+    uninterrupted run — checkpoints carry the data position, and the
+    epoch stream fast-forwards to exactly the next untrained batch."""
+    _write_train_hdf5(tmp_path / "train.hdf5", rng)
+    guard_cfg = GuardConfig(save_every_steps=2)
+
+    # reference: uninterrupted
+    logs_a = []
+    state_a = train(
+        _cfg(guard=guard_cfg, log_every_steps=1),
+        str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt_a"),
+        log=logs_a.append,
+    )
+
+    # interrupted at epoch 1, batch 3 (after the batch-2 mid-save)
+    def interrupting_log(msg, _logs=[]):
+        if "epoch 1 step 3/4" in msg:
+            raise _Interrupt(msg)
+
+    with pytest.raises(_Interrupt):
+        train(
+            _cfg(guard=guard_cfg, log_every_steps=1),
+            str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt_b"),
+            log=interrupting_log,
+        )
+    # the mid-epoch latest-only checkpoint is on disk and committed
+    status, detail = verify_manifest(str(tmp_path / "ckpt_b" / "latest"))
+    assert status == "ok", detail
+
+    logs_b = []
+    state_b = train(
+        _cfg(guard=guard_cfg, log_every_steps=1),
+        str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt_b"),
+        log=logs_b.append,
+    )
+    # resumed mid-epoch: epoch 1, batch 2 (not epoch-granular!)
+    assert any(
+        "resumed from step 6 (epoch 1, batch 2," in l for l in logs_b
+    ), logs_b[:5]
+    _assert_params_equal(state_a.params, state_b.params)
+    assert int(jax.device_get(state_a.step)) == int(
+        jax.device_get(state_b.step)
+    )
+
+    # loss-curve identity: epoch 1's summary metrics match exactly
+    def epoch_metrics(logs, epoch):
+        for l in logs:
+            m = re.match(
+                rf"epoch {epoch}: (train_loss \S+ val_acc \S+ val_loss \S+)", l
+            )
+            if m:
+                return m.group(1)
+        raise AssertionError(f"no epoch {epoch} summary in {logs}")
+
+    assert epoch_metrics(logs_a, 1) == epoch_metrics(logs_b, 1)
+
+
+@pytest.mark.slow  # 3 train runs; the fallback chain itself is covered
+# fast by test_restore_fallback_chain_and_refusal, and under real
+# SIGKILL by test_fault_injection's slow subprocess tests
+def test_corrupt_latest_resume_falls_back_and_completes(rng, tmp_path):
+    """Training resume over a corrupted ``latest`` (the mid-save SIGKILL
+    signature) falls back to the newest numbered checkpoint with a loud
+    ROKO_GUARD line — and still finishes bit-identically to a clean run,
+    because the replay from the older checkpoint is deterministic."""
+    _write_train_hdf5(tmp_path / "train.hdf5", rng)
+    state_clean = train(
+        _cfg(epochs=3), str(tmp_path / "train.hdf5"),
+        str(tmp_path / "ckpt_clean"), log=lambda s: None,
+    )
+
+    train(
+        _cfg(epochs=2), str(tmp_path / "train.hdf5"),
+        str(tmp_path / "ckpt"), log=lambda s: None,
+    )
+    _corrupt(str(tmp_path / "ckpt" / "latest"))
+    logs = []
+    state = train(
+        _cfg(epochs=3), str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+    assert any("ROKO_GUARD event=ckpt_corrupt" in l for l in logs)
+    # fell back to the step-8 numbered checkpoint (same content as the
+    # corrupted latest), then trained epoch 2
+    assert any("resumed from step 8 " in l for l in logs)
+    _assert_params_equal(state_clean.params, state.params)

@@ -97,6 +97,14 @@ def test_two_process_train_and_polish(rng, tmp_path):
         for p in (0, 1)
     ]
     outs = [p.communicate(timeout=840)[0] for p in procs]
+    if any(
+        "Multiprocess computations aren't implemented" in out for out in outs
+    ):
+        pytest.skip(
+            "this jax build has no CPU multiprocess collectives "
+            "(\"Multiprocess computations aren't implemented on the CPU "
+            "backend\")"
+        )
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
     assert "WORKER_0_OK" in outs[0] and "WORKER_1_OK" in outs[1]
