@@ -227,7 +227,8 @@ SWEEP_BATCHES = (BATCH, 2048)
 
 
 def run_inference_suite(
-    batch: Optional[int] = None, progress=None
+    batch: Optional[int] = None, progress=None,
+    iters: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Both device recurrence paths (lax.scan vs fused Pallas), on TPU
     across a small batch sweep (the serial recurrence amortises over
@@ -249,7 +250,11 @@ def run_inference_suite(
     # --batch bypasses the sweep; off-TPU the sweep answers no question
     # (no MXU to saturate) and would multiply CPU bench wall time.
     batches = SWEEP_BATCHES if batch is None and on_tpu else (batch or BATCH,)
-    detail: Dict[str, Any] = {"batch": batches[0]}
+    # fixed-work mode (--bench-iterations): a pinned, recorded iteration
+    # count so cross-round deltas compare identical work (ROADMAP watch
+    # item 6 — wall-clock-shaped sampling made r04->r05 uninterpretable)
+    iters = ITERS if iters is None else iters
+    detail: Dict[str, Any] = {"batch": batches[0], "iterations": iters}
     cfg = ModelConfig(compute_dtype="bfloat16")
     cfg_p = ModelConfig(compute_dtype="bfloat16", use_pallas=True)
     best, best_batch, sweep = 0.0, None, {}
@@ -262,7 +267,7 @@ def run_inference_suite(
         sweep[str(b)] = rates
         try:
             d_s: Dict[str, Any] = {}
-            rates["scan"] = round(bench_infer(cfg, b, detail=d_s), 1)
+            rates["scan"] = round(bench_infer(cfg, b, iters, detail=d_s), 1)
             rates["scan_warmup_seconds"] = d_s.get("warmup_seconds")
         except Exception as e:
             rates["scan_error"] = f"{type(e).__name__}: {e}"[:300]
@@ -271,7 +276,9 @@ def run_inference_suite(
         if on_tpu:
             try:
                 d_p: Dict[str, Any] = {}
-                rates["pallas"] = round(bench_infer(cfg_p, b, detail=d_p), 1)
+                rates["pallas"] = round(
+                    bench_infer(cfg_p, b, iters, detail=d_p), 1
+                )
                 rates["pallas_warmup_seconds"] = d_p.get("warmup_seconds")
             except Exception as e:  # report, never swallow (VERDICT r2)
                 rates["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
@@ -306,7 +313,8 @@ def run_inference_suite(
 
 
 def run_train_suite(
-    batch: int = BATCH, budget_s: Optional[float] = None, progress=None
+    batch: int = BATCH, budget_s: Optional[float] = None, progress=None,
+    iters: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Fill the BASELINE.md 'measure & report' rows: flagship GRU train
     step (configs[1]), 4-layer/2x-hidden scan-depth stress (configs[3]),
@@ -322,7 +330,8 @@ def run_train_suite(
 
     t0 = time.perf_counter()
     peak = _device_peak_flops()
-    out: Dict[str, Any] = {"batch": batch}
+    iters = ITERS if iters is None else iters
+    out: Dict[str, Any] = {"batch": batch, "iterations": iters}
     # Order = information value under a tight budget (each suite costs
     # ~60-90s of fresh compile; the default 480s budget fits four to
     # six — rows that don't fit are reported skipped, never hidden):
@@ -370,6 +379,7 @@ def run_train_suite(
                 r = bench_train(
                     cfg,
                     batch,
+                    iters,
                     rng_impl="rbg" if name.endswith("_rbg") else "threefry",
                 )
                 r["windows_per_sec"] = round(r["windows_per_sec"], 1)
@@ -541,8 +551,11 @@ def _measure(args) -> Dict[str, Any]:
             return  # non-serializable fragment: skip it, keep measuring
         _flush_partial()
 
+    bench_iters = getattr(args, "bench_iterations", None)
     _stamp("inference suite (batch sweep)")
-    detail = run_inference_suite(args.batch, progress=_merge_flush)
+    detail = run_inference_suite(
+        args.batch, progress=_merge_flush, iters=bench_iters
+    )
     running_detail.update(detail)
     _flush_partial()
     # the driver's end-of-round run invokes plain `python bench.py`; on
@@ -554,12 +567,13 @@ def _measure(args) -> Dict[str, Any]:
     if args.train:
         _stamp("train suite (unbounded)")
         detail["train"] = run_train_suite(
-            args.batch or BATCH, progress=train_progress
+            args.batch or BATCH, progress=train_progress, iters=bench_iters
         )
     elif jax.default_backend() == "tpu" and train_budget > 0:
         _stamp(f"train suite (budget {train_budget:.0f}s)")
         detail["train"] = run_train_suite(
-            args.batch or BATCH, budget_s=train_budget, progress=train_progress
+            args.batch or BATCH, budget_s=train_budget,
+            progress=train_progress, iters=bench_iters,
         )
     if args.features:
         _stamp("features suite")
@@ -611,6 +625,20 @@ def _measure(args) -> Dict[str, Any]:
         except Exception as e:  # report, never swallow
             detail["coldstart"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("coldstart", detail["coldstart"])
+    fleet_workers = getattr(args, "fleet_workers", None)
+    if fleet_workers is None:
+        # default follows the e2e scale decision (as coldstart):
+        # contract-mode runs skip it, the driver's plain run measures it
+        fleet_workers = (1, 2) if e2e_draft else ()
+    if fleet_workers:
+        _stamp(f"fleet suite (workers {tuple(fleet_workers)})")
+        try:
+            detail["fleet"] = run_fleet_suite(
+                fleet_workers, iterations=bench_iters or FLEET_ITERS
+            )
+        except Exception as e:  # report, never swallow
+            detail["fleet"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("fleet", detail["fleet"])
     _stamp("torch reference")
     ref_windows_per_sec = bench_torch_reference()
     # provenance: which stack produced this artifact (BENCH_r{N}.json is
@@ -711,6 +739,13 @@ def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
                 "--coldstart-ladder",
                 ",".join(str(r) for r in args.coldstart_ladder) or "0",
             ]
+        if getattr(args, "fleet_workers", None) is not None:
+            cmd += [
+                "--fleet-workers",
+                ",".join(str(n) for n in args.fleet_workers) or "0",
+            ]
+        if getattr(args, "bench_iterations", None) is not None:
+            cmd += ["--bench-iterations", str(args.bench_iterations)]
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         rc, out = _spawn_logged(cmd, budget_s, cwd=repo_root)
         if rc == 0:
@@ -1163,6 +1198,243 @@ def run_coldstart_suite(
     return results
 
 
+#: fleet suite fixed work per client (overridden by --bench-iterations)
+FLEET_ITERS = 25
+FLEET_CLIENTS = 3
+#: windows per request — one bottom-ladder rung, so every request is a
+#: single padded dispatch and req/s compares across worker counts
+FLEET_REQUEST_WINDOWS = 8
+
+
+def run_fleet_suite(
+    worker_counts=(1, 2),
+    iterations: int = FLEET_ITERS,
+    clients: int = FLEET_CLIENTS,
+    config_json: Optional[str] = None,
+    startup_budget_s: float = 600.0,
+) -> Dict[str, Any]:
+    """Saturation + fault tolerance of the multi-worker serving tier
+    (serve/fleet.py): FIXED-WORK closed-loop load — ``clients`` client
+    threads each issue ``iterations`` polish requests — against the
+    supervised fleet at each worker count, reporting sustained req/s
+    and p99 latency, the scaling efficiency between 1 and 2 workers,
+    and a forced-fault phase: the same load with one worker SIGKILLed
+    mid-run, where ``client_errors`` MUST stay 0 (failover makes the
+    kill a latency event) and req/s shows the degradation cost.
+
+    Workers are real subprocesses (full serve stack each); when the
+    bench parent owns a TPU the workers are pinned to CPU instead of
+    fighting over chips the parent holds — the suite then measures the
+    routing/supervision tier, honestly labeled in ``note``."""
+    import dataclasses
+    import tempfile
+    import threading
+
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import RokoConfig
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.serve.client import PolishClient
+    from roko_tpu.serve.fleet import Fleet
+    from roko_tpu.serve.supervisor import make_front_server, worker_command
+    from roko_tpu.training.checkpoint import save_params
+
+    cfg = (
+        RokoConfig.from_json(config_json) if config_json else RokoConfig()
+    )
+    worker_env_extra: Dict[str, str] = {}
+    results: Dict[str, Any] = {
+        "iterations": iterations,
+        "clients": clients,
+        "windows_per_request": FLEET_REQUEST_WINDOWS,
+        "workers": {},
+    }
+    if jax.default_backend() == "tpu":
+        worker_env_extra["JAX_PLATFORMS"] = "cpu"
+        results["note"] = (
+            "bench parent holds the TPU; fleet workers ran on CPU — "
+            "this row measures the routing/supervision tier, not chip "
+            "throughput"
+        )
+    cfg = dataclasses.replace(
+        cfg,
+        serve=dataclasses.replace(
+            cfg.serve, ladder=(FLEET_REQUEST_WINDOWS,), max_delay_ms=5.0
+        ),
+        fleet=dataclasses.replace(
+            cfg.fleet,
+            heartbeat_interval_s=0.25,
+            heartbeat_timeout_s=5.0,
+            stable_after_s=1.0,
+            restart_base_delay_s=0.1,
+        ),
+    )
+    rng = np.random.default_rng(0)
+    rows, cols = cfg.model.window_rows, cfg.model.window_cols
+    stride = cfg.window.stride
+    n_win = FLEET_REQUEST_WINDOWS
+    x = rng.integers(0, C.FEATURE_VOCAB, (n_win, rows, cols)).astype(np.uint8)
+    positions = np.zeros((n_win, cols, 2), np.int64)
+    for i in range(n_win):
+        positions[i, :, 0] = np.arange(i * stride, i * stride + cols)
+    draft = "".join(
+        rng.choice(list("ACGT"), (n_win - 1) * stride + cols + 10)
+    )
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+
+    with tempfile.TemporaryDirectory() as td:
+        ckpt = os.path.join(td, "params")
+        save_params(ckpt, params)
+        cfg_path = os.path.join(td, "worker-config.json")
+        with open(cfg_path, "w") as f:
+            f.write(
+                dataclasses.replace(
+                    cfg, fleet=dataclasses.replace(cfg.fleet, workers=0)
+                ).to_json()
+            )
+
+        def start_fleet(n: int):
+            fcfg = dataclasses.replace(
+                cfg, fleet=dataclasses.replace(cfg.fleet, workers=n)
+            )
+            fleet = Fleet(
+                fcfg,
+                worker_command(ckpt, cfg_path),
+                worker_env=lambda wid: dict(worker_env_extra),
+                runtime_dir=os.path.join(td, f"fleet-{n}"),
+                log=lambda m: None,
+            )
+            fleet.start()
+            # front end binds only after the workers are ready: the
+            # timeout path then has no bound socket or serving thread
+            # to leak into the rest of the bench process
+            deadline = time.monotonic() + startup_budget_s
+            while fleet.ready_count() < n:
+                if time.monotonic() > deadline:
+                    fleet.stop(rolling=False)
+                    raise RuntimeError(
+                        f"fleet of {n} not ready within "
+                        f"{startup_budget_s:.0f}s"
+                    )
+                time.sleep(0.2)
+            server = make_front_server(fleet, port=0)
+            thread = threading.Thread(target=server.serve_forever, daemon=True)
+            thread.start()
+            return fleet, server, thread
+
+        def stop_fleet(fleet, server, thread):
+            server.shutdown()
+            server.server_close()
+            thread.join(10.0)
+            fleet.stop(rolling=False)
+
+        def drive(port: int, per_client: int, mid_action=None):
+            """Closed-loop fixed work; ``mid_action(done)`` fires after
+            every completed request (the kill phase hooks it)."""
+            lat: list = []
+            errors: list = []
+            lock = threading.Lock()
+
+            def one_client():
+                client = PolishClient(
+                    f"http://127.0.0.1:{port}", timeout=300.0
+                )
+                for _ in range(per_client):
+                    t0 = time.perf_counter()
+                    try:
+                        client.polish(draft, positions, x, retries=8)
+                    except Exception as e:
+                        with lock:
+                            errors.append(
+                                f"{type(e).__name__}: {e}"[:200]
+                            )
+                    else:
+                        with lock:
+                            lat.append(time.perf_counter() - t0)
+                    if mid_action is not None:
+                        with lock:
+                            done = len(lat) + len(errors)
+                        mid_action(done)
+
+            threads = [
+                threading.Thread(target=one_client, daemon=True)
+                for _ in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0, lat, errors
+
+        for n in worker_counts:
+            fleet, server, thread = start_fleet(n)
+            try:
+                port = server.server_address[1]
+                drive(port, 1)  # untimed: first-dispatch costs off-clock
+                wall, lat, errors = drive(port, iterations)
+                row: Dict[str, Any] = {
+                    "req_per_s": round(clients * iterations / wall, 2),
+                    "p99_s": round(float(np.percentile(lat, 99)), 4)
+                    if lat else None,
+                    "mean_s": round(float(np.mean(lat)), 4) if lat else None,
+                    "client_errors": len(errors),
+                }
+                if errors:
+                    row["errors"] = errors[:5]
+                results["workers"][str(n)] = row
+            finally:
+                stop_fleet(fleet, server, thread)
+        r1 = results["workers"].get("1", {}).get("req_per_s")
+        r2 = results["workers"].get("2", {}).get("req_per_s")
+        if r1 and r2:
+            results["scaling_efficiency"] = round(r2 / (2 * r1), 3)
+
+        # forced-fault phase: SIGKILL one worker mid-load at the top
+        # worker count; failover must keep client_errors at 0
+        n_kill = max(worker_counts)
+        if n_kill >= 2:
+            fleet, server, thread = start_fleet(n_kill)
+            try:
+                port = server.server_address[1]
+                drive(port, 1)
+                total = clients * iterations
+                killed = threading.Event()
+
+                def kill_at_quarter(done: int) -> None:
+                    if not killed.is_set() and done >= max(2, total // 4):
+                        killed.set()
+                        fleet.workers[0].proc.kill()
+
+                wall, lat, errors = drive(
+                    port, iterations, mid_action=kill_at_quarter
+                )
+                rejoined = False
+                deadline = time.monotonic() + startup_budget_s
+                while time.monotonic() < deadline:
+                    if fleet.ready_count() == n_kill:
+                        rejoined = True
+                        break
+                    time.sleep(0.2)
+                kill_row: Dict[str, Any] = {
+                    "workers": n_kill,
+                    "req_per_s_during_kill": round(total / wall, 2),
+                    "p99_s": round(float(np.percentile(lat, 99)), 4)
+                    if lat else None,
+                    "client_errors": len(errors),
+                    "failovers": fleet.counter("failovers"),
+                    "restarts": fleet.counter("restarts"),
+                    "worker_rejoined": rejoined,
+                }
+                if errors:
+                    kill_row["errors"] = errors[:5]
+                results["forced_kill"] = kill_row
+            finally:
+                stop_fleet(fleet, server, thread)
+    return results
+
+
 def main(argv=None) -> None:
     import argparse
     import os
@@ -1206,6 +1478,24 @@ def main(argv=None) -> None:
         "persistent cache vs AOT bundle time-to-first-prediction; "
         f"default {','.join(str(r) for r in DEFAULT_COLDSTART_LADDER)} "
         "when the e2e suite runs; 0 disables)",
+    )
+    ap.add_argument(
+        "--fleet-workers",
+        type=_coldstart_ladder_type,
+        default=None,
+        help="fleet saturation suite worker counts (sustained req/s + "
+        "p99 per count, scaling efficiency, req/s during a forced "
+        "worker SIGKILL; default 1,2 when the e2e suite runs; "
+        "0 disables)",
+    )
+    ap.add_argument(
+        "--bench-iterations",
+        type=int,
+        default=None,
+        help="fixed-work mode: pin the timed iteration count of the "
+        "inference/train suites and the per-client request count of "
+        "the fleet suite (recorded in the artifact; ROADMAP watch "
+        "item 6)",
     )
     ap.add_argument(
         "--in-process",

@@ -237,6 +237,11 @@ def _build_config(args: argparse.Namespace):
         breaker_failures="breaker_failures", breaker_reset_s="breaker_reset_s",
         drain_deadline_s="drain_deadline",
     )
+    fleet = over(
+        base.fleet,
+        workers="workers", devices_per_worker="devices_per_worker",
+        heartbeat_interval_s="heartbeat_interval",
+    )
     compile_cfg = over(
         base.compile,
         cache_dir="compile_cache", cache_max_mb="cache_max_mb",
@@ -255,7 +260,7 @@ def _build_config(args: argparse.Namespace):
         guard = dataclasses.replace(guard, enabled=False)
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
-        model=model, train=train, mesh=mesh, serve=serve,
+        model=model, train=train, mesh=mesh, serve=serve, fleet=fleet,
         pipeline=pipeline, resilience=resilience, compile=compile_cfg,
         guard=guard,
     )
@@ -377,6 +382,10 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--pipeline-draft", str(args.pipeline_draft)]
     if args.coldstart_ladder is not None:
         argv += ["--coldstart-ladder", args.coldstart_ladder]
+    if args.bench_iterations is not None:
+        argv += ["--bench-iterations", str(args.bench_iterations)]
+    if args.fleet_workers is not None:
+        argv += ["--fleet-workers", args.fleet_workers]
     if args.in_process:
         argv.append("--in-process")
     bench_main(argv)
@@ -555,20 +564,43 @@ def cmd_serve(args: argparse.Namespace) -> int:
     the persistent cache), then serve ``POST /polish`` with dynamic
     micro-batching until interrupted. While warming, ``/healthz`` says
     ``"warming"`` and ``/polish`` sheds with 503+Retry-After — the
-    socket is never dark, and the not-ready window is observable."""
+    socket is never dark, and the not-ready window is observable.
+
+    With ``--workers N`` (N >= 1) this process becomes the fleet
+    SUPERVISOR instead (docs/SERVING.md "Multi-worker topology &
+    failure handling"): it forks N of these single-process servers —
+    each pinned to a device slice, each announcing its ephemeral port
+    back — and runs the failover-routing front end over them. The
+    supervisor never touches jax devices itself (on TPU it must not
+    claim the chips its workers need)."""
     import threading
     import time
+
+    cfg = _build_config(args)
+    if cfg.fleet.workers > 0 and args.worker_id is None:
+        from roko_tpu.serve.supervisor import run_supervisor
+
+        return run_supervisor(args.model, cfg, announce=args.announce)
 
     from roko_tpu.compile import enable_persistent_cache
     from roko_tpu.serve import PolishSession, make_server, serve_forever
 
-    cfg = _build_config(args)
     cache_dir = enable_persistent_cache(cfg.compile)
     if cache_dir:
         print(f"serve: persistent compile cache at {cache_dir}")
     params = _load_model_params(args.model, cfg)
     session = PolishSession(params, cfg)
-    server = make_server(session, cfg.serve, warming=True)
+    server = make_server(
+        session, cfg.serve, warming=True, worker_id=args.worker_id
+    )
+    if args.announce:
+        # fleet workers (and test automation) bind port 0; the bound
+        # address is handed back through an atomically-renamed file —
+        # written AFTER bind, BEFORE warmup, so the supervisor can
+        # heartbeat the warming window
+        from roko_tpu.serve.fleet import write_announce
+
+        write_announce(args.announce, server.server_address[1])
     print(
         f"serve: warming predict ladder {session.ladder} "
         "(healthz=warming; /polish sheds until ready) ..."
@@ -839,6 +871,21 @@ def build_parser() -> argparse.ArgumentParser:
         "bundle time-to-first-prediction), e.g. 32,128; 0 disables",
     )
     p.add_argument(
+        "--bench-iterations", type=int, default=None,
+        help="fixed-work mode: pin the timed iteration count for the "
+        "inference/train suites (and the per-client request count of "
+        "the fleet suite) instead of the built-in default — keeps "
+        "cross-round deltas interpretable on noisy boxes "
+        "(ROADMAP watch item 6)",
+    )
+    p.add_argument(
+        "--fleet-workers", default=None,
+        help="fleet saturation suite worker counts, e.g. 1,2 "
+        "(req/s + p99 per count, scaling efficiency, req/s during a "
+        "forced worker SIGKILL; default 1,2 when the e2e suite runs; "
+        "0 disables)",
+    )
+    p.add_argument(
         "--in-process",
         action="store_true",
         help="skip the sick-backend probe/fallback orchestration",
@@ -933,6 +980,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="confine the /polish ref+bam form to files under this "
         "directory (recommended when binding beyond localhost)",
     )
+    p.add_argument(
+        "--workers", type=int, default=None,
+        help="fleet mode: fork this many worker serve processes (each "
+        "owning a device slice) behind a supervising front end that "
+        "restarts crashed/hung workers and fails requests over "
+        "(default 0 = classic single process; docs/SERVING.md "
+        "'Multi-worker topology')",
+    )
+    p.add_argument(
+        "--devices-per-worker", type=int, default=None,
+        help="fleet mode: devices each worker may see (visible-device "
+        "pinning; default 0 = no pinning, CPU only)",
+    )
+    p.add_argument(
+        "--heartbeat-interval", type=float, default=None,
+        help="fleet mode: seconds between supervisor /healthz probes "
+        "of each worker (default 2)",
+    )
+    # fleet-internal plumbing (the supervisor passes these to its
+    # children; automation may use --announce to learn a port-0 bind)
+    p.add_argument("--worker-id", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--announce", default=None, help=argparse.SUPPRESS)
     _config_arg(p)
     _model_args(p)
     _mesh_args(p)

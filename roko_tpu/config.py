@@ -169,6 +169,64 @@ class ServeConfig:
 
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Multi-worker serving tier (roko_tpu/serve/fleet.py +
+    supervisor.py; docs/SERVING.md "Multi-worker topology & failure
+    handling"): a supervising front end forks ``workers`` serve
+    processes, each pinned to a device slice, and routes around
+    crashed/hung/breaker-tripped workers."""
+
+    #: worker process count; 0 = classic single-process `roko-tpu serve`
+    #: (no supervisor, no fleet)
+    workers: int = 0
+    #: devices each worker may see (visible-device pinning via
+    #: ``parallel.mesh.fleet_worker_env``); 0 = no pinning — every
+    #: worker sees all devices (only sane on CPU, where "devices" are
+    #: process-local virtual ones)
+    devices_per_worker: int = 0
+    #: supervisor heartbeat cadence: seconds between /healthz probes of
+    #: each worker (liveness AND readiness ride the same probe)
+    heartbeat_interval_s: float = 2.0
+    #: per-probe HTTP timeout; an unanswered probe is a missed heartbeat
+    heartbeat_timeout_s: float = 5.0
+    #: consecutive missed heartbeats after which a worker is declared
+    #: hung and killed (SIGTERM, then SIGKILL after ``term_grace_s``)
+    heartbeat_misses: int = 3
+    #: seconds a fresh worker gets to bind its socket and announce its
+    #: port (warmup has its own budget: a warming worker answers
+    #: /healthz 503 "warming", which counts as a heartbeat)
+    spawn_deadline_s: float = 120.0
+    #: SIGTERM -> SIGKILL escalation grace for hung/drained workers
+    term_grace_s: float = 10.0
+    #: restart backoff: delay before restart k is
+    #: ``restart_base_delay_s * 2**(k-1)`` capped at
+    #: ``restart_max_delay_s`` (shared RetryPolicy shape + jitter)
+    restart_base_delay_s: float = 0.5
+    restart_max_delay_s: float = 30.0
+    #: restart-storm circuit breaker: this many restarts without an
+    #: intervening stable period mark the worker FAILED (the fleet
+    #: degrades instead of flapping); after ``storm_reset_s`` one
+    #: half-open probe restart is admitted
+    storm_threshold: int = 5
+    storm_reset_s: float = 60.0
+    #: seconds a restarted worker must stay in rotation before its
+    #: restart-storm breaker records success and the backoff resets
+    stable_after_s: float = 30.0
+    #: distinct workers one request may be routed to before the front
+    #: end gives up with 503 (failover: a worker dying mid-request is
+    #: retried transparently — polish is idempotent)
+    failover_attempts: int = 3
+    #: front-end admission control: concurrent in-flight requests
+    #: beyond this are shed with 503 + Retry-After; 0 = workers x
+    #: serve.max_queue
+    max_inflight: int = 0
+    #: worker logs + port-announce files live here; None = a
+    #: ``roko-fleet-<pid>`` directory under the system tmpdir (where CI
+    #: failure dumps look for surviving-worker stderr)
+    runtime_dir: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class PipelineConfig:
     """Streaming polish engine (roko_tpu/pipeline, docs/PIPELINE.md):
     feature extraction, host batching, and device inference run as one
@@ -291,6 +349,7 @@ class RokoConfig:
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
@@ -312,6 +371,7 @@ class RokoConfig:
             mesh=MeshConfig(**raw.get("mesh", {})),
             serve=ServeConfig(**{k: tuple(v) if k == "ladder" else v
                                  for k, v in raw.get("serve", {}).items()}),
+            fleet=FleetConfig(**raw.get("fleet", {})),
             pipeline=PipelineConfig(**raw.get("pipeline", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             compile=CompileConfig(**raw.get("compile", {})),

@@ -89,6 +89,85 @@ def shard_batch(mesh: Mesh, batch):
     return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
 
 
+def fleet_worker_slice(
+    worker_index: int, num_workers: int, devices_per_worker: int
+) -> "list[int]":
+    """Contiguous device-id slice a fleet worker owns: worker ``i`` of
+    ``n`` gets ids ``[i*k, (i+1)*k)`` for ``k = devices_per_worker`` —
+    the same contiguous-slice convention ``make_mesh`` uses to reshape
+    ``jax.devices()`` into axes, so neighbouring workers sit on
+    ICI-adjacent chips."""
+    if worker_index < 0 or worker_index >= num_workers:
+        raise ValueError(
+            f"worker_index {worker_index} outside fleet of {num_workers}"
+        )
+    if devices_per_worker < 1:
+        raise ValueError("devices_per_worker must be >= 1 to pin a slice")
+    first = worker_index * devices_per_worker
+    return list(range(first, first + devices_per_worker))
+
+
+def fleet_worker_env(
+    worker_index: int,
+    num_workers: int,
+    devices_per_worker: int = 0,
+    backend: Optional[str] = None,
+) -> "dict[str, str]":
+    """Environment overlay pinning one fleet worker process to its
+    device slice. Pure computation — deliberately touches no jax device
+    API, because the SUPERVISOR calls it and must never initialise a
+    backend itself (on TPU, initialising would claim the very chips the
+    workers need). The overlay must be applied before the worker
+    process imports jax; the worker's default ``dp=-1`` mesh then
+    absorbs exactly its visible slice.
+
+    ``devices_per_worker == 0`` returns an empty overlay: every worker
+    sees all devices (only sane on CPU, where host "devices" are
+    process-local virtual constructs, not shared hardware).
+
+    ``backend`` defaults from ``JAX_PLATFORMS``; when it cannot be
+    determined, both TPU and GPU visibility vars are set — harmless on
+    whichever stack is absent."""
+    import os
+    import re
+
+    if devices_per_worker <= 0:
+        return {}
+    if backend is None:
+        backend = (
+            (os.environ.get("JAX_PLATFORMS") or "").split(",")[0].strip()
+            or None
+        )
+    env: "dict[str, str]" = {}
+    if backend == "cpu":
+        # virtual host devices are per-process: each worker simply
+        # creates its own count (there is no shared id space to slice)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        env["XLA_FLAGS"] = (
+            flags
+            + f" --xla_force_host_platform_device_count={devices_per_worker}"
+        ).strip()
+        return env
+    ids = ",".join(
+        str(i)
+        for i in fleet_worker_slice(
+            worker_index, num_workers, devices_per_worker
+        )
+    )
+    if backend in (None, "tpu"):
+        # per-chip process split: each worker's libtpu claims only its
+        # chips (the multi-process-per-host convention TPU serving
+        # stacks use; megacore chips count as one id here)
+        env["TPU_VISIBLE_DEVICES"] = ids
+    if backend in (None, "gpu", "cuda", "rocm"):
+        env["CUDA_VISIBLE_DEVICES"] = ids
+    return env
+
+
 def put_replicated(tree, mesh: Mesh):
     """Replicate a host pytree over the whole mesh, multi-host safe.
 

@@ -19,22 +19,33 @@ Modules:
 - ``server``   — ``ThreadingHTTPServer`` front end
   (``POST /polish``, ``GET /healthz``, ``GET /metrics``)
 - ``client``   — stdlib urllib client used by tests and ``tools/``
+- ``fleet``    — multi-worker tier: process supervision (heartbeats,
+  restart backoff, restart-storm breaker) + failover routing
+- ``supervisor`` — the ``--workers N`` front end over a ``fleet``
+  (admission control, rolling SIGTERM drain, metrics aggregation)
 """
 
 from roko_tpu.serve.batcher import Backpressure, MicroBatcher
-from roko_tpu.serve.client import PolishClient, ServerBusy
+from roko_tpu.serve.client import PolishClient, ServerBusy, ServiceUnavailable
+from roko_tpu.serve.fleet import Fleet, WorkerHandle
 from roko_tpu.serve.metrics import ServeMetrics
 from roko_tpu.serve.server import drain, make_server, serve_forever
 from roko_tpu.serve.session import PolishSession
+from roko_tpu.serve.supervisor import make_front_server, run_supervisor
 
 __all__ = [
     "Backpressure",
+    "Fleet",
     "MicroBatcher",
     "PolishClient",
     "PolishSession",
     "ServeMetrics",
     "ServerBusy",
+    "ServiceUnavailable",
+    "WorkerHandle",
     "drain",
+    "make_front_server",
     "make_server",
+    "run_supervisor",
     "serve_forever",
 ]

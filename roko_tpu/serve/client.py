@@ -7,7 +7,9 @@ through busy replies with the shared
 :class:`roko_tpu.resilience.RetryPolicy` — exponential backoff +
 jitter, FLOORED by the server's ``Retry-After`` (the server names the
 minimum wait; the growing backoff and jitter keep a fleet of rejected
-clients from returning in lockstep).
+clients from returning in lockstep). An exhausted retry budget raises
+the typed :class:`ServiceUnavailable` with the attempt count — shed
+load is debuggable, not a bare HTTPError.
 """
 
 from __future__ import annotations
@@ -30,6 +32,24 @@ class ServerBusy(RuntimeError):
     def __init__(self, retry_after_s: float):
         super().__init__(f"server busy; retry after {retry_after_s:.1f}s")
         self.retry_after_s = retry_after_s
+
+
+class ServiceUnavailable(ServerBusy):
+    """The retry budget was exhausted against 503s: every one of
+    ``attempts`` tries was shed (queue full, breaker open, fleet
+    degraded, or draining). Typed — not a bare HTTPError — so
+    fleet-level load shedding is debuggable from the client side:
+    ``attempts`` says how hard the client pushed and ``retry_after_s``
+    what the server last asked for."""
+
+    def __init__(self, retry_after_s: float, attempts: int):
+        RuntimeError.__init__(
+            self,
+            f"service unavailable: all {attempts} attempt(s) got 503; "
+            f"last Retry-After {retry_after_s:.1f}s",
+        )
+        self.retry_after_s = retry_after_s
+        self.attempts = attempts
 
 
 def _b64(arr: np.ndarray, dtype) -> str:
@@ -97,19 +117,26 @@ class PolishClient:
         :class:`ServerBusy` replies (503: queue full, breaker open, or
         draining) with the policy's backoff floored by the server's
         ``Retry-After`` — never failing on the first backpressure
-        response unless asked to (``retries=0``)."""
+        response unless asked to (``retries=0``). Exhausting the budget
+        raises the typed :class:`ServiceUnavailable` (a ServerBusy
+        subclass) carrying the attempt count."""
         import dataclasses
 
         policy = dataclasses.replace(
             self.retry_policy, max_attempts=retries + 1
         )
-        return json.loads(
-            policy.call(
-                lambda: self._request("/polish", payload),
-                retry_after=lambda e: getattr(e, "retry_after_s", None),
-                sleep=self._sleep,
+        try:
+            return json.loads(
+                policy.call(
+                    lambda: self._request("/polish", payload),
+                    retry_after=lambda e: getattr(e, "retry_after_s", None),
+                    sleep=self._sleep,
+                )
             )
-        )
+        except ServiceUnavailable:
+            raise
+        except ServerBusy as e:
+            raise ServiceUnavailable(e.retry_after_s, retries + 1) from e
 
     def polish(
         self,

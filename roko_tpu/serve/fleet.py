@@ -1,0 +1,651 @@
+"""Worker fleet: process supervision + failover routing for the
+multi-worker serving tier (docs/SERVING.md "Multi-worker topology &
+failure handling").
+
+One :class:`Fleet` owns N worker processes, each a full single-process
+``roko-tpu serve`` stack (warm PolishSession + MicroBatcher + HTTP)
+pinned to a device slice (``parallel.mesh.fleet_worker_env``) and
+sharing one AOT bundle. The fleet's job is to make worker failure a
+latency event, never a correctness or availability event:
+
+- **liveness** — every worker is heartbeat-probed on ``/healthz``
+  (``FleetConfig.heartbeat_interval_s``); any answer — 200 ready, 503
+  warming/draining/breaker-open — proves the process alive, but only a
+  200 keeps it in rotation. ``heartbeat_misses`` consecutive
+  *unanswered* probes declare it hung.
+- **supervision** — a crashed worker (``waitpid`` via ``Popen.poll``)
+  or a hung one (SIGTERM, then SIGKILL after ``term_grace_s``) is
+  restarted under the shared :class:`~roko_tpu.resilience.RetryPolicy`
+  exponential-backoff shape, guarded by a per-worker restart-storm
+  :class:`~roko_tpu.resilience.CircuitBreaker`: ``storm_threshold``
+  restarts without a ``stable_after_s`` healthy stretch mark the worker
+  FAILED and the fleet degrades (serves on the survivors) instead of
+  flapping; after ``storm_reset_s`` one half-open probe restart is
+  admitted.
+- **failover** — :meth:`Fleet.post_polish` routes a request to a ready
+  worker; a connection-level failure (the worker died or was killed
+  mid-request) transparently re-dispatches to another ready worker —
+  polish is deterministic and idempotent, so clients observe latency,
+  not errors. Worker 503s (busy/warming) try the next worker once each
+  before surfacing as a fleet 503 with the largest ``Retry-After``
+  seen.
+- **restart re-warm** — a restarted worker re-enters rotation only
+  after its own warmup flips ``/healthz`` to 200 (AOT bundle
+  deserialization when one is configured; binds-first/warming-503
+  semantics from ``serve/server.py``).
+
+The supervisor front end (``serve/supervisor.py``) puts the HTTP
+surface over this class; tests drive it directly with stub worker
+processes, so the supervision machinery is covered by real
+kill/restart/waitpid paths without paying a jax import per worker.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import shutil
+import subprocess
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from roko_tpu.config import FleetConfig, RokoConfig
+from roko_tpu.resilience import CircuitBreaker, RetryPolicy
+from roko_tpu.serve.metrics import parse_metric_values
+
+# worker lifecycle states (rendered in /healthz and the
+# roko_fleet_worker_state gauge)
+STARTING = "starting"    # spawned, port not yet announced
+WARMING = "warming"      # bound, ladder still compiling (healthz 503)
+READY = "ready"          # in rotation
+UNHEALTHY = "unhealthy"  # alive but out of rotation (breaker tripped)
+DRAINING = "draining"    # worker reports draining (rolling restart)
+DEAD = "dead"            # process gone; restart scheduled
+FAILED = "failed"        # restart-storm breaker open; not restarting
+STOPPED = "stopped"      # deliberately terminated (fleet drain)
+
+#: gauge encoding for roko_fleet_worker_state
+STATE_CODES = {
+    READY: 0, WARMING: 1, STARTING: 1, UNHEALTHY: 2, DRAINING: 3,
+    DEAD: 4, FAILED: 5, STOPPED: 6,
+}
+
+#: worker series re-exported at the front end labeled by worker id
+#: (ISSUE satellite: compile-cache + breaker gauges per worker)
+PASSTHROUGH_SERIES = (
+    ("roko_serve_breaker_state", "gauge"),
+    ("roko_serve_breaker_trips_total", "counter"),
+    ("roko_compile_cache_hits", "counter"),
+    ("roko_compile_cache_misses", "counter"),
+)
+
+#: connection-level failures that mean "this worker did not answer" —
+#: the failover trigger (a dead/killed worker mid-request lands here)
+_CONN_ERRORS = (OSError, http.client.HTTPException)
+
+
+def write_announce(path: str, port: int) -> None:
+    """Atomically publish a bound address as ``{"pid", "port"}`` — the
+    contract between a port-0 bind and whoever needs the port (the
+    supervisor's ``_read_announce``, test automation). One writer for
+    the worker CLI and the supervisor front end."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "port": int(port)}, f)
+    os.replace(tmp, path)
+
+
+def _tail(path: str, n: int = 2000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            f.seek(max(0, f.tell() - n))
+            return f.read().decode(errors="replace")
+    except OSError:
+        return "(no worker log)"
+
+
+class WorkerHandle:
+    """One supervised worker process: its Popen, announced port,
+    lifecycle state, heartbeat bookkeeping, and restart-storm breaker."""
+
+    def __init__(self, wid: int, runtime_dir: str, cfg: FleetConfig):
+        self.id = wid
+        self.announce_path = os.path.join(
+            runtime_dir, f"worker-{wid}.announce.json"
+        )
+        self.log_path = os.path.join(runtime_dir, f"worker-{wid}.log")
+        self.proc: Optional[subprocess.Popen] = None
+        self.port: Optional[int] = None
+        self.state = DEAD
+        self.spawned_at = 0.0
+        self.restart_at = 0.0   # monotonic time the next restart is due
+        self.restarts = 0       # lifetime respawn count (metrics)
+        self.attempt = 0        # consecutive deaths w/o a stable stretch
+        self.misses = 0         # consecutive unanswered heartbeats
+        self.ready_since = 0.0
+        self.stable = False     # this incarnation survived stable_after_s
+        #: restart-storm breaker: record_failure per death, record_success
+        #: once stable; OPEN = stop restarting (fleet degrades), half-open
+        #: after storm_reset_s admits exactly one probe restart
+        self.storm = CircuitBreaker(
+            failure_threshold=max(1, cfg.storm_threshold),
+            reset_s=cfg.storm_reset_s,
+        )
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Fleet:
+    """Spawn, supervise, and route across N worker processes.
+
+    ``worker_command(worker_id, announce_path) -> argv`` builds each
+    worker's command line (the supervisor builds a ``roko-tpu serve``
+    invocation; tests substitute a stdlib stub worker), and
+    ``worker_env(worker_id) -> dict`` the per-worker environment overlay
+    (device-slice pinning by default)."""
+
+    def __init__(
+        self,
+        cfg: RokoConfig,
+        worker_command: Callable[[int, str], List[str]],
+        *,
+        worker_env: Optional[Callable[[int], Dict[str, str]]] = None,
+        runtime_dir: Optional[str] = None,
+        log: Callable[[str], None] = print,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg
+        fc = cfg.fleet
+        if fc.workers < 1:
+            raise ValueError("FleetConfig.workers must be >= 1 for a fleet")
+        self.fleet_cfg = fc
+        self._command = worker_command
+        self._env = worker_env or (lambda wid: {})
+        self._log = log
+        self._clock = clock
+        self.runtime_dir = (
+            runtime_dir
+            or fc.runtime_dir
+            or os.path.join(
+                tempfile.gettempdir(), f"roko-fleet-{os.getpid()}"
+            )
+        )
+        #: removed on a CLEAN stop only — a wedged run leaves the worker
+        #: logs behind for the CI failure dump to collect
+        self._own_runtime_dir = runtime_dir is None and fc.runtime_dir is None
+        self.workers = [
+            WorkerHandle(i, self.runtime_dir, fc) for i in range(fc.workers)
+        ]
+        self.restart_policy = RetryPolicy(
+            base_delay_s=fc.restart_base_delay_s,
+            max_delay_s=fc.restart_max_delay_s,
+            jitter=0.1,
+        )
+        self.max_inflight = fc.max_inflight or (
+            fc.workers * cfg.serve.max_queue
+        )
+        self._lock = threading.RLock()
+        self._rr = 0
+        self._counters = {"restarts": 0, "failovers": 0,
+                          "requests": 0, "rejected": 0}
+        self._stop = threading.Event()
+        self._draining = False
+        self._drain_done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- counters -----------------------------------------------------------
+
+    def inc(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += by
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters[name]
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        """Spawn every worker and start the supervision thread."""
+        os.makedirs(self.runtime_dir, exist_ok=True)
+        now = self._clock()
+        for w in self.workers:
+            self._spawn(w, now)
+        self._thread = threading.Thread(
+            target=self._supervise, name="roko-fleet-supervise", daemon=True
+        )
+        self._thread.start()
+
+    def _spawn(self, w: WorkerHandle, now: float) -> None:
+        try:
+            os.unlink(w.announce_path)
+        except OSError:
+            pass
+        env = dict(os.environ)
+        env.update(self._env(w.id))
+        env["ROKO_WORKER_ID"] = str(w.id)
+        # append: across restarts one log per worker slot keeps the
+        # whole crash history in a single CI-dumpable file
+        logf = open(w.log_path, "ab", buffering=0)
+        try:
+            logf.write(
+                f"\n--- spawn worker {w.id} (restart {w.restarts}) ---\n"
+                .encode()
+            )
+            w.proc = subprocess.Popen(
+                self._command(w.id, w.announce_path),
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                env=env,
+            )
+        finally:
+            logf.close()  # the child keeps its own copy of the fd
+        w.state = STARTING
+        w.spawned_at = now
+        w.port = None
+        w.misses = 0
+        w.stable = False
+
+    def stop(
+        self, *, rolling: bool = True, cleanup: bool = True
+    ) -> None:
+        """Stop supervision, then terminate workers — sequentially
+        (rolling: each worker gets its own SIGTERM drain + exit before
+        the next is touched) or in one sweep (``rolling=False``, the
+        Ctrl-C path). Idempotent; a second caller BLOCKS until the
+        first stop finishes (the supervisor's exit path must not
+        return while the SIGTERM drain thread is still terminating
+        workers — orphans would outlive the supervisor)."""
+        with self._lock:
+            first = not self._draining
+            self._draining = True
+        grace = (
+            self.cfg.resilience.drain_deadline_s
+            + self.fleet_cfg.term_grace_s
+        )
+        if not first:
+            self._drain_done.wait((grace + 5.0) * (len(self.workers) + 1))
+            return
+        try:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(
+                    self.fleet_cfg.heartbeat_timeout_s
+                    + self.fleet_cfg.heartbeat_interval_s + 5.0
+                )
+            if not rolling:
+                for w in self.workers:
+                    if w.alive():
+                        w.proc.terminate()
+            for w in self.workers:
+                self._terminate(w, grace)
+                w.state = STOPPED
+            if cleanup and self._own_runtime_dir:
+                shutil.rmtree(self.runtime_dir, ignore_errors=True)
+        finally:
+            self._drain_done.set()
+
+    def _terminate(self, w: WorkerHandle, grace_s: float) -> None:
+        """SIGTERM (the worker drains its in-flight requests), escalate
+        to SIGKILL after ``grace_s``."""
+        if not w.alive():
+            return
+        w.proc.terminate()
+        try:
+            w.proc.wait(grace_s)
+        except subprocess.TimeoutExpired:
+            self._log(
+                f"roko fleet: worker {w.id} ignored SIGTERM for "
+                f"{grace_s:.0f}s; escalating to SIGKILL"
+            )
+            w.proc.kill()
+            try:
+                w.proc.wait(self.fleet_cfg.term_grace_s)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+
+    # -- supervision --------------------------------------------------------
+
+    def _supervise(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception as e:  # pragma: no cover - defensive
+                self._log(f"roko fleet: supervision tick failed: {e!r}")
+            self._stop.wait(self.fleet_cfg.heartbeat_interval_s)
+
+    def tick(self) -> None:
+        """One supervision pass over every worker (public so tests can
+        drive supervision synchronously with a fake clock)."""
+        for w in self.workers:
+            if self._draining:
+                return
+            self._check(w, self._clock())
+
+    def _check(self, w: WorkerHandle, now: float) -> None:
+        cfg = self.fleet_cfg
+        if w.state in (FAILED, DEAD):
+            if w.state == DEAD and now < w.restart_at:
+                return
+            # storm breaker gates the respawn: CLOSED passes, OPEN
+            # refuses (FAILED = degraded fleet), half-open admits one
+            # probe restart after storm_reset_s
+            if w.storm.allow():
+                if w.state == FAILED:
+                    self._log(
+                        f"roko fleet: worker {w.id} storm breaker half-open;"
+                        " admitting one probe restart"
+                    )
+                self._restart(w, now)
+            elif w.state != FAILED:
+                w.state = FAILED
+                self._log(
+                    f"roko fleet: worker {w.id} restart storm "
+                    f"({cfg.storm_threshold} restarts without a stable "
+                    f"stretch) — marking FAILED, fleet degraded; next "
+                    f"probe in {cfg.storm_reset_s:.0f}s"
+                )
+            return
+        rc = w.proc.poll() if w.proc is not None else None
+        if rc is not None:
+            self._note_death(w, now, f"exited rc={rc}")
+            return
+        if w.state == STARTING:
+            port = self._read_announce(w)
+            if port is not None:
+                w.port = port
+                w.state = WARMING
+                self._log(
+                    f"roko fleet: worker {w.id} bound 127.0.0.1:{port} "
+                    "(warming)"
+                )
+            elif now - w.spawned_at > cfg.spawn_deadline_s:
+                self._kill_hung(
+                    w, now,
+                    f"never announced within {cfg.spawn_deadline_s:.0f}s",
+                )
+            return
+        # bound: heartbeat via /healthz
+        try:
+            code, body = self._probe(w, "/healthz")
+        except _CONN_ERRORS:
+            w.misses += 1
+            if w.misses >= cfg.heartbeat_misses:
+                self._kill_hung(
+                    w, now, f"{w.misses} consecutive missed heartbeats"
+                )
+            return
+        w.misses = 0
+        status = body.get("status", "")
+        if code == 200:
+            if w.state != READY:
+                self._log(f"roko fleet: worker {w.id} in rotation")
+                w.state = READY
+                w.ready_since = now
+            elif not w.stable and now - w.ready_since >= cfg.stable_after_s:
+                # survived the probation window: the storm breaker
+                # records recovery and the backoff schedule resets
+                w.stable = True
+                w.attempt = 0
+                w.storm.record_success()
+        elif status == "warming":
+            w.state = WARMING
+        elif status == "draining":
+            w.state = DRAINING
+        else:
+            # breaker-tripped (or otherwise unhealthy) but answering:
+            # out of rotation, left alive — the worker's own half-open
+            # probing may recover it without losing the warm session
+            if w.state != UNHEALTHY:
+                self._log(
+                    f"roko fleet: worker {w.id} out of rotation "
+                    f"(healthz {code} status={status or '?'})"
+                )
+            w.state = UNHEALTHY
+
+    def _read_announce(self, w: WorkerHandle) -> Optional[int]:
+        try:
+            with open(w.announce_path) as f:
+                return int(json.load(f)["port"])
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def _note_death(self, w: WorkerHandle, now: float, why: str) -> None:
+        w.storm.record_failure()
+        delay = self.restart_policy.delay_for(w.attempt + 1)
+        w.attempt += 1
+        w.restart_at = now + delay
+        w.state = DEAD
+        w.port = None
+        self._log(
+            f"roko fleet: worker {w.id} {why}; restart "
+            f"{w.attempt} in {delay:.1f}s; log tail:\n{_tail(w.log_path)}"
+        )
+
+    def _kill_hung(self, w: WorkerHandle, now: float, why: str) -> None:
+        self._log(
+            f"roko fleet: worker {w.id} presumed hung ({why}); "
+            "SIGTERM -> SIGKILL"
+        )
+        self._terminate(w, self.fleet_cfg.term_grace_s)
+        self._note_death(w, now, f"killed ({why})")
+
+    def _restart(self, w: WorkerHandle, now: float) -> None:
+        w.restarts += 1
+        self.inc("restarts")
+        try:
+            self._spawn(w, now)
+        except OSError as e:  # spawn itself failed: another death
+            self._note_death(w, now, f"respawn failed: {e}")
+
+    # -- routing ------------------------------------------------------------
+
+    def ready_count(self) -> int:
+        return sum(1 for w in self.workers if w.state == READY)
+
+    def pick(
+        self, exclude: Sequence[int] = ()
+    ) -> Optional[Tuple[WorkerHandle, int]]:
+        """Round-robin over in-rotation workers, skipping ``exclude``
+        (ids already tried for this request). Returns the handle AND a
+        port snapshot taken under the lock: the supervision thread
+        nulls ``w.port`` when a worker dies, and reading it later would
+        race — ``HTTPConnection(host, None)`` silently falls back to
+        port 80."""
+        with self._lock:
+            ready = [
+                w for w in self.workers
+                if w.state == READY and w.id not in exclude
+                and w.port is not None
+            ]
+            if not ready:
+                return None
+            self._rr += 1
+            w = ready[self._rr % len(ready)]
+            return w, w.port
+
+    def post_polish(
+        self, body: bytes, timeout: Optional[float] = None
+    ) -> Tuple[int, bytes, Dict[str, str]]:
+        """Route one ``POST /polish`` body to a ready worker with
+        transparent failover: a connection-level failure (worker died
+        mid-request) retries on another ready worker — polish is
+        idempotent, so the client sees added latency, never the crash.
+        Worker 503s try the next worker, then surface as a fleet 503
+        with the largest ``Retry-After`` observed. Returns
+        ``(status, reply_body, extra_headers)``."""
+        cfg = self.fleet_cfg
+        tried: List[int] = []
+        retry_after = self.cfg.serve.retry_after_s
+        for _ in range(max(1, cfg.failover_attempts)):
+            picked = self.pick(exclude=tried)
+            if picked is None:
+                break
+            w, port = picked
+            tried.append(w.id)
+            try:
+                code, reply, hdrs = self._forward(port, body, timeout)
+            except _CONN_ERRORS as e:
+                # the worker vanished mid-request: suspect it (the
+                # supervision loop confirms via waitpid/heartbeat and
+                # restarts it) and fail over
+                self.inc("failovers")
+                self._log(
+                    f"roko fleet: worker {w.id} dropped a request "
+                    f"({type(e).__name__}); failing over"
+                )
+                with self._lock:
+                    if w.state == READY:
+                        w.state = UNHEALTHY
+                continue
+            if code == 503:
+                try:
+                    retry_after = max(
+                        retry_after, float(hdrs.get("Retry-After", 0))
+                    )
+                except ValueError:
+                    pass
+                continue
+            return code, reply, {}
+        body_out = json.dumps({
+            "error": "no worker available (fleet busy or degraded)",
+            "retry_after_s": retry_after,
+        }).encode()
+        return 503, body_out, {"Retry-After": f"{max(1, round(retry_after))}"}
+
+    def _forward(
+        self, port: int, body: bytes, timeout: Optional[float] = None
+    ) -> Tuple[int, bytes, Dict[str, str]]:
+        """One POST /polish to one worker's snapshotted port, no
+        retries here. The default read timeout is generous (a polish
+        can legitimately take minutes); a worker that HANGS mid-request
+        is killed by the supervision loop, which closes this socket and
+        converts the hang into a connection error -> failover."""
+        from roko_tpu.serve.server import REQUEST_TIMEOUT_S
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", port,
+            timeout=REQUEST_TIMEOUT_S if timeout is None else timeout,
+        )
+        try:
+            conn.request(
+                "POST", "/polish", body=body,
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, data, dict(resp.getheaders())
+        finally:
+            conn.close()
+
+    def _probe(
+        self, w: WorkerHandle, path: str
+    ) -> Tuple[int, Dict[str, object]]:
+        """GET a worker's JSON endpoint with the heartbeat timeout;
+        HTTP error codes (503 warming/unhealthy) parse as answers, only
+        transport failures raise."""
+        url = f"http://127.0.0.1:{w.port}{path}"
+        try:
+            with urllib.request.urlopen(
+                url, timeout=self.fleet_cfg.heartbeat_timeout_s
+            ) as r:
+                return r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read() or b"{}")
+            except ValueError:
+                return e.code, {}
+
+    # -- observation --------------------------------------------------------
+
+    def summary(self) -> Dict[str, object]:
+        """The supervisor ``/healthz`` body: aggregate status + the
+        per-worker state map."""
+        up = self.ready_count()
+        states = {
+            str(w.id): {
+                "state": w.state,
+                "port": w.port,
+                "restarts": w.restarts,
+            }
+            for w in self.workers
+        }
+        if self._draining:
+            status, code = "draining", 503
+        elif up == len(self.workers):
+            status, code = "ok", 200
+        elif up >= 1:
+            # serving on the survivors: a load balancer may still route
+            # here, but the degradation is visible
+            status, code = "degraded", 200
+        elif any(w.state in (WARMING, STARTING) for w in self.workers):
+            status, code = "warming", 503
+        else:
+            status, code = "unhealthy", 503
+        return {
+            "status": status,
+            "code": code,
+            "workers": states,
+            "workers_up": up,
+        }
+
+    def render_metrics(self) -> str:
+        """The supervisor ``/metrics`` body: fleet gauges/counters plus
+        selected per-worker series re-labeled by worker id (scraped
+        live from each bound worker with the heartbeat timeout;
+        unanswering workers are simply absent from the passthrough)."""
+        p = "roko_fleet_"
+        lines = [
+            f"# TYPE {p}workers gauge",
+            f"{p}workers {len(self.workers)}",
+            f"# TYPE {p}workers_up gauge",
+            f"{p}workers_up {self.ready_count()}",
+        ]
+        for name in ("restarts", "failovers", "requests", "rejected"):
+            lines.append(f"# TYPE {p}{name}_total counter")
+            lines.append(f"{p}{name}_total {self.counter(name)}")
+        lines.append(f"# TYPE {p}worker_state gauge")
+        for w in self.workers:
+            lines.append(
+                f'{p}worker_state{{worker="{w.id}"}} '
+                f"{STATE_CODES.get(w.state, 9)}"
+            )
+        lines.append(f"# TYPE {p}worker_restarts_total counter")
+        for w in self.workers:
+            lines.append(
+                f'{p}worker_restarts_total{{worker="{w.id}"}} {w.restarts}'
+            )
+        names = tuple(n for n, _ in PASSTHROUGH_SERIES)
+        scraped: Dict[int, Dict[str, str]] = {}
+        for w in self.workers:
+            if w.port is None or not w.alive():
+                continue
+            try:
+                url = f"http://127.0.0.1:{w.port}/metrics"
+                with urllib.request.urlopen(
+                    url, timeout=self.fleet_cfg.heartbeat_timeout_s
+                ) as r:
+                    scraped[w.id] = parse_metric_values(
+                        r.read().decode(), names
+                    )
+            except _CONN_ERRORS:  # URLError subclasses OSError
+                continue
+        for name, kind in PASSTHROUGH_SERIES:
+            rows = [
+                (wid, vals[name])
+                for wid, vals in sorted(scraped.items())
+                if name in vals
+            ]
+            if not rows:
+                continue
+            lines.append(f"# TYPE {name} {kind}")
+            for wid, val in rows:
+                lines.append(f'{name}{{worker="{wid}"}} {val}')
+        return "\n".join(lines) + "\n"

@@ -44,6 +44,23 @@ _PREFIX = "roko_serve_"
 _COUNTERS = ("requests", "windows", "batches", "rejected", "errors")
 
 
+def parse_metric_values(text: str, names) -> Dict[str, str]:
+    """Extract ``{name: value}`` for unlabeled series in a Prometheus
+    text body — the fleet supervisor scrapes each worker's ``/metrics``
+    with this and re-exports the selected series labeled by worker id
+    (``serve/fleet.py`` PASSTHROUGH_SERIES). Values stay strings: the
+    aggregator relays, it does not do arithmetic."""
+    wanted = set(names)
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split()
+        if len(parts) == 2 and parts[0] in wanted:
+            out[parts[0]] = parts[1]
+    return out
+
+
 class ServeMetrics:
     def __init__(self, latency_samples: int = 1024):
         self._lock = threading.Lock()

@@ -229,11 +229,12 @@ def _polish_bam(
     return {"contigs": board.stitch_all(), "windows": n}
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # set by make_server on the class copy
-    batcher: MicroBatcher
-    metrics: ServeMetrics
-    data_root: Optional[str] = None
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared handler base for the serve tier's HTTP surfaces — the
+    single-process worker front end below and the fleet supervisor
+    (``serve/supervisor.py``): JSON replies, bounded body reads, and
+    drain-aware in-flight accounting over the lifecycle state
+    ``init_lifecycle`` attaches to the server object."""
 
     protocol_version = "HTTP/1.1"
     #: socket timeout for reads on one request: a peer that promises
@@ -262,6 +263,36 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply_json(self, code: int, obj: Dict[str, Any], **kw: Any) -> None:
         self._reply(code, json.dumps(obj).encode(), **kw)
 
+    def _read_body(self, max_bytes: int = MAX_BODY_BYTES) -> Optional[bytes]:
+        """Validate ``Content-Length`` and read the request body; on a
+        bad header (400) or oversized body (413) the error reply is
+        sent here and ``None`` returned. A peer stalling mid-body still
+        raises ``TimeoutError`` out of the read (socket timeout) for
+        the caller to map."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+        except ValueError:
+            # body length unknown -> can't resync the keep-alive
+            # stream; close after replying
+            self.close_connection = True
+            self._reply_json(400, {"error": "bad Content-Length header"})
+            return None
+        if length < 0:
+            # rfile.read(-1) would block until the peer closes —
+            # a handler thread pinned forever per such request
+            self.close_connection = True
+            self._reply_json(400, {"error": "bad Content-Length header"})
+            return None
+        if length > max_bytes:
+            # body left unread: a keep-alive peer would otherwise
+            # have its next request parsed out of these bytes
+            self.close_connection = True
+            self._reply_json(
+                413, {"error": f"body exceeds {max_bytes} bytes"}
+            )
+            return None
+        return self.rfile.read(length)
+
     @contextlib.contextmanager
     def _track_inflight(self):
         """Count this request in the server's in-flight set so a drain
@@ -276,6 +307,33 @@ class _Handler(BaseHTTPRequestHandler):
             with srv._inflight_lock:
                 srv._inflight -= 1
 
+
+def init_lifecycle(
+    server: ThreadingHTTPServer,
+    drain_deadline_s: float,
+    warming: bool = False,
+) -> None:
+    """Attach the drain/warming lifecycle state ``drain`` and
+    :class:`JsonRequestHandler` expect: the `_draining`/`_warming`
+    events, the in-flight counter, and the drain deadline. One
+    implementation for the worker server and the fleet supervisor."""
+    server.daemon_threads = True
+    server._draining = threading.Event()  # type: ignore[attr-defined]
+    server._warming = threading.Event()  # type: ignore[attr-defined]
+    if warming:
+        server._warming.set()  # type: ignore[attr-defined]
+    server._inflight = 0  # type: ignore[attr-defined]
+    server._inflight_lock = threading.Lock()  # type: ignore[attr-defined]
+    server.drain_deadline_s = drain_deadline_s  # type: ignore[attr-defined]
+
+
+class _Handler(JsonRequestHandler):
+    # set by make_server on the class copy
+    batcher: MicroBatcher
+    metrics: ServeMetrics
+    data_root: Optional[str] = None
+    worker_id: Optional[int] = None
+
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         if self.path == "/healthz":
             session = self.batcher.session
@@ -289,6 +347,10 @@ class _Handler(BaseHTTPRequestHandler):
                 # session stand-ins need not model the fail-over)
                 "cpu_fallback": getattr(session, "failed_over", False),
             }
+            if self.worker_id is not None:
+                # fleet workers carry their id so the supervisor (and a
+                # human curl) can confirm who answered after restarts
+                body["worker_id"] = self.worker_id
             code = 200
             if breaker is not None:
                 body["breaker"] = breaker.state
@@ -353,29 +415,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _handle_polish(self) -> None:
         try:
-            try:
-                length = int(self.headers.get("Content-Length", "0"))
-            except ValueError:
-                # body length unknown -> can't resync the keep-alive
-                # stream; close after replying
-                self.close_connection = True
-                self._reply_json(400, {"error": "bad Content-Length header"})
-                return
-            if length < 0:
-                # rfile.read(-1) would block until the peer closes —
-                # a handler thread pinned forever per such request
-                self.close_connection = True
-                self._reply_json(400, {"error": "bad Content-Length header"})
-                return
-            if length > MAX_BODY_BYTES:
-                # body left unread: a keep-alive peer would otherwise
-                # have its next request parsed out of these bytes
-                self.close_connection = True
-                self._reply_json(
-                    413, {"error": f"body exceeds {MAX_BODY_BYTES} bytes"}
-                )
-                return
-            payload = json.loads(self.rfile.read(length).decode())
+            raw = self._read_body()
+            if raw is None:
+                return  # error reply already sent
+            payload = json.loads(raw.decode())
             if not isinstance(payload, dict):
                 raise _BadRequest("payload must be a JSON object")
             if "bam" in payload:
@@ -424,6 +467,7 @@ def make_server(
     host: Optional[str] = None,
     port: Optional[int] = None,
     warming: bool = False,
+    worker_id: Optional[int] = None,
 ) -> ThreadingHTTPServer:
     """Bind (port 0 = ephemeral) and return the server; the caller runs
     ``serve_forever``. The batcher/metrics/breaker ride on the server
@@ -461,24 +505,18 @@ def make_server(
     handler = type("RokoServeHandler", (_Handler,), {
         "batcher": batcher, "metrics": metrics,
         "data_root": serve_cfg.data_root,
+        "worker_id": worker_id,
     })
     server = ThreadingHTTPServer(
         (serve_cfg.host if host is None else host,
          serve_cfg.port if port is None else port),
         handler,
     )
-    server.daemon_threads = True
     server.batcher = batcher  # type: ignore[attr-defined]
     server.metrics = metrics  # type: ignore[attr-defined]
     server.session = session  # type: ignore[attr-defined]
     server.breaker = breaker  # type: ignore[attr-defined]
-    server._draining = threading.Event()  # type: ignore[attr-defined]
-    server._warming = threading.Event()  # type: ignore[attr-defined]
-    if warming:
-        server._warming.set()  # type: ignore[attr-defined]
-    server._inflight = 0  # type: ignore[attr-defined]
-    server._inflight_lock = threading.Lock()  # type: ignore[attr-defined]
-    server.drain_deadline_s = rcfg.drain_deadline_s  # type: ignore[attr-defined]
+    init_lifecycle(server, rcfg.drain_deadline_s, warming=warming)
     return server
 
 
@@ -521,19 +559,23 @@ def drain(
     return left == 0
 
 
-def serve_forever(server: ThreadingHTTPServer, log=print) -> None:
+def serve_forever(server: ThreadingHTTPServer, log=print, drain_fn=None) -> None:
     """Blocking loop with clean shutdown on Ctrl-C and a graceful
-    SIGTERM drain (finish in-flight, reject new, then exit)."""
+    SIGTERM drain (finish in-flight, reject new, then exit).
+    ``drain_fn`` overrides what SIGTERM runs — the fleet supervisor
+    passes its rolling drain (front end first, then workers one at a
+    time); the default is :func:`drain` on this server alone."""
     host, port = server.server_address[:2]
     log(f"roko serve: listening on http://{host}:{port} "
         f"(POST /polish, GET /healthz, GET /metrics)")
+    if drain_fn is None:
+        drain_fn = lambda: drain(server, log=log)  # noqa: E731
 
     def _on_sigterm(signum, frame):
         # drain blocks (and calls shutdown, which must not run on the
         # serve_forever thread) — hand it to a worker
         threading.Thread(
-            target=drain, args=(server,), kwargs={"log": log},
-            name="roko-serve-drain", daemon=True,
+            target=drain_fn, name="roko-serve-drain", daemon=True
         ).start()
 
     try:
@@ -547,5 +589,7 @@ def serve_forever(server: ThreadingHTTPServer, log=print) -> None:
     except KeyboardInterrupt:
         log("roko serve: shutting down")
     finally:
-        server.batcher.stop()  # type: ignore[attr-defined]
+        batcher = getattr(server, "batcher", None)
+        if batcher is not None:  # the supervisor front end has none
+            batcher.stop()
         server.server_close()

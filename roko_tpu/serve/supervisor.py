@@ -1,0 +1,227 @@
+"""Supervising front end for the multi-worker serving tier
+(docs/SERVING.md "Multi-worker topology & failure handling").
+
+``roko-tpu serve CKPT --workers N`` runs THIS process instead of a
+PolishSession: it forks N ``roko-tpu serve`` worker processes (each a
+full warm single-process stack pinned to its device slice, sharing one
+AOT bundle) via :class:`~roko_tpu.serve.fleet.Fleet`, and puts a thin
+HTTP surface over the fleet:
+
+- ``POST /polish`` — admission control (bounded in-flight, 503 +
+  ``Retry-After`` past it) then failover routing: the body is relayed
+  verbatim to a ready worker; a worker dying mid-request is retried on
+  another worker transparently (polish is idempotent), so clients see
+  latency, never the crash.
+- ``GET /healthz`` — fleet aggregate (``ok`` / ``degraded`` with 200,
+  ``warming`` / ``unhealthy`` / ``draining`` with 503) plus the
+  per-worker state map.
+- ``GET /metrics`` — ``roko_fleet_*`` series plus selected per-worker
+  gauges re-labeled by worker id.
+
+The supervisor process NEVER initialises a jax backend: on TPU it must
+not claim the chips its workers need, so it loads no params, builds no
+mesh, and computes device slices with the pure
+``parallel.mesh.fleet_worker_env`` helper.
+
+SIGTERM is a rolling drain: the front end stops admitting and finishes
+in-flight relays first, then workers are SIGTERMed one at a time (each
+drains its own in-flight under ``--drain-deadline``, escalating to
+SIGKILL after ``term_grace_s``) — no mid-request connection resets on
+the way down.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from http.server import ThreadingHTTPServer
+from typing import Callable, List, Optional
+
+from roko_tpu.config import RokoConfig
+from roko_tpu.parallel.mesh import fleet_worker_env
+from roko_tpu.serve.fleet import Fleet, write_announce
+from roko_tpu.serve.server import (
+    JsonRequestHandler,
+    drain,
+    init_lifecycle,
+    serve_forever,
+)
+
+
+class _FrontHandler(JsonRequestHandler):
+    # set by make_front_server on the class copy
+    fleet: Fleet
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path == "/healthz":
+            body = self.fleet.summary()
+            if self.server._draining.is_set():  # type: ignore[attr-defined]
+                body["status"], body["code"] = "draining", 503
+            code = body.pop("code")
+            self._reply_json(code, body)
+        elif self.path == "/metrics":
+            self._reply(
+                200,
+                self.fleet.render_metrics().encode(),
+                content_type="text/plain; version=0.0.4",
+            )
+        else:
+            self._reply_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path != "/polish":
+            self._reply_json(404, {"error": f"no route {self.path}"})
+            return
+        fleet = self.fleet
+        retry = fleet.cfg.serve.retry_after_s
+        with self._track_inflight():
+            # draining checked AFTER the increment (same TOCTOU rule as
+            # the worker server: drain() watches the counter)
+            if self.server._draining.is_set():  # type: ignore[attr-defined]
+                self.close_connection = True
+                self._reply_json(
+                    503,
+                    {"error": "fleet draining", "retry_after_s": retry},
+                    extra={"Retry-After": f"{max(1, round(retry))}"},
+                )
+                return
+            with self.server._inflight_lock:  # type: ignore[attr-defined]
+                inflight = self.server._inflight  # type: ignore[attr-defined]
+            if inflight > fleet.max_inflight:
+                # admission control: past the fleet's aggregate queue
+                # capacity, shed here instead of stacking relays behind
+                # workers that will 503 anyway
+                fleet.inc("rejected")
+                self._reply_json(
+                    503,
+                    {"error": "fleet at capacity",
+                     "retry_after_s": retry},
+                    extra={"Retry-After": f"{max(1, round(retry))}"},
+                )
+                return
+            try:
+                body = self._read_body()
+            except TimeoutError:
+                # peer stalled mid-body past the socket timeout
+                self.close_connection = True
+                self._reply_json(
+                    503, {"error": "timed out reading the request"}
+                )
+                return
+            if body is None:
+                return  # error reply already sent
+            fleet.inc("requests")
+            code, reply, extra = fleet.post_polish(body)
+            if code == 503:
+                self.close_connection = True
+            self._reply(code, reply, extra=extra)
+
+
+def make_front_server(
+    fleet: Fleet,
+    host: Optional[str] = None,
+    port: Optional[int] = None,
+) -> ThreadingHTTPServer:
+    """Bind the supervisor front end (port 0 = ephemeral) and return
+    the server; the caller runs ``serve_forever``. The fleet rides on
+    the server object (``.fleet``) and the lifecycle state matches the
+    worker server's, so :func:`roko_tpu.serve.server.drain` works on
+    it unchanged."""
+    serve_cfg = fleet.cfg.serve
+    handler = type("RokoFleetHandler", (_FrontHandler,), {"fleet": fleet})
+    server = ThreadingHTTPServer(
+        (serve_cfg.host if host is None else host,
+         serve_cfg.port if port is None else port),
+        handler,
+    )
+    server.fleet = fleet  # type: ignore[attr-defined]
+    init_lifecycle(server, fleet.cfg.resilience.drain_deadline_s)
+    return server
+
+
+def worker_command(
+    model_path: str, config_path: str
+) -> Callable[[int, str], List[str]]:
+    """argv builder for real ``roko-tpu serve`` workers: ephemeral
+    loopback port, port announced through ``announce_path``, config via
+    the shared JSON (``--worker-id`` keeps the child out of supervisor
+    mode)."""
+
+    def build(worker_id: int, announce_path: str) -> List[str]:
+        return [
+            sys.executable, "-m", "roko_tpu", "serve", model_path,
+            "--config", config_path,
+            "--host", "127.0.0.1", "--port", "0",
+            "--worker-id", str(worker_id),
+            "--announce", announce_path,
+        ]
+
+    return build
+
+
+def rolling_drain(
+    server: ThreadingHTTPServer, fleet: Fleet, log=print
+) -> None:
+    """SIGTERM path: drain the front end (reject new, finish in-flight
+    relays, stop the accept loop), THEN terminate workers one at a
+    time — each worker drains its own in-flight before the next is
+    touched."""
+    drain(server, log=log)
+    log("roko fleet: rolling worker drain")
+    fleet.stop(rolling=True)
+
+
+def run_supervisor(
+    model_path: str,
+    cfg: RokoConfig,
+    *,
+    announce: Optional[str] = None,
+    log=print,
+) -> int:
+    """The ``roko-tpu serve --workers N`` entry point: spawn the fleet,
+    bind the front end, serve until SIGTERM/Ctrl-C. ``announce`` (used
+    by tests/automation) writes ``{"pid", "port"}`` once the front-end
+    socket is bound — the same contract workers honour."""
+    fc = cfg.fleet
+    # the worker config: fleet.workers zeroed so a worker can never
+    # recurse into supervisor mode, everything else (model geometry,
+    # serve ladder, AOT bundle, resilience knobs) shared verbatim
+    import dataclasses
+
+    fleet = Fleet(
+        cfg,
+        worker_command=(lambda *_: []),  # bound below, needs runtime_dir
+        worker_env=lambda wid: fleet_worker_env(
+            wid, fc.workers, fc.devices_per_worker
+        ),
+        log=log,
+    )
+    os.makedirs(fleet.runtime_dir, exist_ok=True)
+    config_path = os.path.join(fleet.runtime_dir, "worker-config.json")
+    worker_cfg = dataclasses.replace(
+        cfg, fleet=dataclasses.replace(fc, workers=0)
+    )
+    with open(config_path, "w") as f:
+        f.write(worker_cfg.to_json())
+    fleet._command = worker_command(model_path, config_path)
+
+    server = make_front_server(fleet)
+    if announce:
+        write_announce(announce, server.server_address[1])
+    log(
+        f"roko fleet: supervising {fc.workers} worker(s) "
+        f"(runtime dir {fleet.runtime_dir}); front end binding"
+    )
+    fleet.start()
+    try:
+        serve_forever(
+            server,
+            log=log,
+            drain_fn=lambda: rolling_drain(server, fleet, log=log),
+        )
+    finally:
+        # Ctrl-C / accept-loop exit: make sure no worker outlives the
+        # supervisor (stop() is idempotent — a completed rolling drain
+        # already did this)
+        fleet.stop(rolling=False)
+    return 0

@@ -1,0 +1,178 @@
+"""Stand-in fleet worker for the supervision tests: the real serve
+surface (``/healthz`` / ``/metrics`` / ``POST /polish``, port-0 bind +
+announce file, graceful SIGTERM drain) with zero jax import cost, so
+``tests/test_fleet.py`` can exercise the REAL kill/waitpid/restart
+machinery in tier-1 — spawn is ~100 ms instead of a ~20 s jax start.
+
+Failure modes are injected through the environment:
+
+- ``STUB_FAIL_START=1``      — exit(1) before binding (crash loop)
+- ``STUB_WARM_S=N``          — report ``warming`` (503) for N seconds
+- ``STUB_CRASH_ON_POLISH=1`` — ``os._exit(9)`` mid-request, no reply
+  (the failover trigger)
+- ``STUB_CRASH_AFTER=N``     — exit(1) after N successful polishes
+- ``STUB_HANG_AFTER_S=T``    — stop answering anything T seconds after
+  start (the hung-worker signature: process alive, heartbeats missed)
+- ``STUB_POLISH_DELAY_S=T``  — hold each polish T seconds (lets a test
+  pin requests in flight across a drain)
+- ``STUB_UNHEALTHY=1``       — healthz 503 "unhealthy" (breaker-open
+  stand-in: alive, out of rotation)
+
+Replies carry this process's pid so tests can see WHICH incarnation
+answered across restarts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+START = time.monotonic()
+DRAINING = threading.Event()
+INFLIGHT = 0
+INFLIGHT_LOCK = threading.Lock()
+POLISHED = 0
+
+WARM_S = float(os.environ.get("STUB_WARM_S", "0"))
+CRASH_ON_POLISH = os.environ.get("STUB_CRASH_ON_POLISH") == "1"
+CRASH_AFTER = int(os.environ.get("STUB_CRASH_AFTER", "0"))
+HANG_AFTER_S = float(os.environ.get("STUB_HANG_AFTER_S", "0"))
+POLISH_DELAY_S = float(os.environ.get("STUB_POLISH_DELAY_S", "0"))
+UNHEALTHY = os.environ.get("STUB_UNHEALTHY") == "1"
+
+METRICS = """\
+# TYPE roko_serve_breaker_state gauge
+roko_serve_breaker_state 0
+# TYPE roko_serve_breaker_trips_total counter
+roko_serve_breaker_trips_total 1
+# TYPE roko_compile_cache_hits counter
+roko_compile_cache_hits 5
+# TYPE roko_compile_cache_misses counter
+roko_compile_cache_misses 2
+"""
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):
+        pass
+
+    def _maybe_hang(self):
+        if HANG_AFTER_S and time.monotonic() - START > HANG_AFTER_S:
+            time.sleep(3600)
+
+    def _reply(self, code, body, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        self.wfile.flush()
+
+    def _reply_json(self, code, obj):
+        self._reply(code, json.dumps(obj).encode())
+
+    def do_GET(self):  # noqa: N802
+        self._maybe_hang()
+        if self.path == "/healthz":
+            if DRAINING.is_set():
+                self._reply_json(503, {"status": "draining"})
+            elif time.monotonic() - START < WARM_S:
+                self._reply_json(503, {"status": "warming"})
+            elif UNHEALTHY:
+                self._reply_json(
+                    503, {"status": "unhealthy", "breaker": "open"}
+                )
+            else:
+                self._reply_json(
+                    200, {"status": "ok", "worker_pid": os.getpid()}
+                )
+        elif self.path == "/metrics":
+            self._reply(200, METRICS.encode(), ctype="text/plain")
+        else:
+            self._reply_json(404, {"error": "no route"})
+
+    def do_POST(self):  # noqa: N802
+        global POLISHED
+        self._maybe_hang()
+        length = int(self.headers.get("Content-Length", "0"))
+        raw = self.rfile.read(length)
+        if CRASH_ON_POLISH:
+            os._exit(9)  # mid-request death: no reply, socket resets
+        with INFLIGHT_LOCK:
+            global INFLIGHT
+            INFLIGHT += 1
+        try:
+            if DRAINING.is_set():
+                self._reply_json(
+                    503, {"error": "draining", "retry_after_s": 1.0}
+                )
+                return
+            if time.monotonic() - START < WARM_S:
+                self._reply_json(
+                    503, {"error": "warming", "retry_after_s": 1.0}
+                )
+                return
+            if POLISH_DELAY_S:
+                time.sleep(POLISH_DELAY_S)
+            try:
+                n = int(json.loads(raw or b"{}").get("n", 0))
+            except ValueError:
+                n = 0
+            self._reply_json(
+                200,
+                {"contig": "stub", "polished": f"STUB-{os.getpid()}",
+                 "windows": n},
+            )
+            POLISHED += 1
+            if CRASH_AFTER and POLISHED >= CRASH_AFTER:
+                time.sleep(0.05)  # let the reply bytes leave the socket
+                os._exit(1)
+        finally:
+            with INFLIGHT_LOCK:
+                INFLIGHT -= 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--announce", required=True)
+    args = ap.parse_args()
+    if os.environ.get("STUB_FAIL_START") == "1":
+        print("stub: failing at start as instructed", file=sys.stderr)
+        return 1
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    server.daemon_threads = True
+    tmp = args.announce + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"pid": os.getpid(), "port": server.server_address[1]}, f)
+    os.replace(tmp, args.announce)
+
+    def on_sigterm(signum, frame):
+        DRAINING.set()
+
+        def drain_and_exit():
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with INFLIGHT_LOCK:
+                    if INFLIGHT == 0:
+                        break
+                time.sleep(0.02)
+            server.shutdown()
+
+        threading.Thread(target=drain_and_exit, daemon=True).start()
+
+    import signal
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,713 @@
+"""Multi-worker serving tier tests (roko_tpu/serve/fleet.py +
+supervisor.py, docs/SERVING.md "Multi-worker topology & failure
+handling").
+
+Tier-1 coverage drives the REAL supervision machinery — subprocess
+spawn, waitpid, SIGTERM/SIGKILL escalation, restart backoff, storm
+breaker, failover routing, rolling drain — against the stdlib stub
+worker (``tests/fleet_stub_worker.py``, ~100 ms per spawn), so crash
+and hang paths run on every push. The ``slow`` tests swap in real
+``roko-tpu serve`` workers for the acceptance bar: SIGKILL mid-load
+with zero client-visible failures and output byte-identical to the
+single-process inference path, plus rejoin-after-re-warm."""
+
+import dataclasses
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from roko_tpu.config import FleetConfig, RokoConfig, ServeConfig
+from roko_tpu.parallel.mesh import fleet_worker_env, fleet_worker_slice
+from roko_tpu.serve.client import PolishClient, ServerBusy, ServiceUnavailable
+from roko_tpu.serve.fleet import (
+    DEAD,
+    FAILED,
+    READY,
+    STOPPED,
+    WARMING,
+    Fleet,
+)
+from roko_tpu.serve.metrics import parse_metric_values
+from roko_tpu.serve.supervisor import make_front_server, rolling_drain
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "fleet_stub_worker.py")
+
+
+def stub_command(worker_id, announce_path):
+    return [sys.executable, STUB, "--announce", announce_path]
+
+
+def fast_fleet_cfg(workers=2, **kw):
+    """Supervision knobs scaled to test time (ms heartbeats, sub-second
+    backoff) — same machinery, faster clock."""
+    base = dict(
+        workers=workers,
+        heartbeat_interval_s=0.1,
+        heartbeat_timeout_s=2.0,
+        heartbeat_misses=3,
+        spawn_deadline_s=20.0,
+        term_grace_s=2.0,
+        restart_base_delay_s=0.05,
+        restart_max_delay_s=0.2,
+        storm_threshold=3,
+        storm_reset_s=3600.0,
+        stable_after_s=0.3,
+    )
+    base.update(kw)
+    return FleetConfig(**base)
+
+
+def make_fleet(tmp_path, workers=2, env_for=None, **fleet_kw):
+    cfg = RokoConfig(
+        serve=ServeConfig(max_queue=8, retry_after_s=0.2),
+        fleet=fast_fleet_cfg(workers, **fleet_kw),
+    )
+    return Fleet(
+        cfg,
+        stub_command,
+        worker_env=env_for or (lambda wid: {}),
+        runtime_dir=str(tmp_path / "fleet"),
+        log=lambda m: None,
+    )
+
+
+def wait_until(pred, timeout=30.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    pytest.fail(f"timed out after {timeout}s waiting for {msg}")
+
+
+def start_front(fleet):
+    server = make_front_server(fleet, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread
+
+
+def stop_front(server, thread):
+    server.shutdown()
+    server.server_close()
+    thread.join(5.0)
+
+
+def get_json(port, path):
+    """GET that treats HTTP error codes as answers (PolishClient maps
+    503 to ServerBusy, which healthz asserts here must see raw)."""
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10
+        ) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def post(client, retries=4, **kw):
+    return client.polish(
+        "ACGT",
+        np.zeros((1, 2, 2), np.int64),
+        np.zeros((1, 2, 3), np.uint8),
+        retries=retries,
+        **kw,
+    )
+
+
+# -- pure units ---------------------------------------------------------------
+
+
+def test_restart_backoff_schedule(tmp_path):
+    """The restart delays follow the shared RetryPolicy shape:
+    base * 2^(k-1) capped at the max (jitter rides on top)."""
+    fleet = make_fleet(tmp_path)
+    exact = dataclasses.replace(fleet.restart_policy, jitter=0.0)
+    assert [exact.delay_for(k) for k in range(1, 5)] == [0.05, 0.1, 0.2, 0.2]
+    # default production schedule: 0.5 doubling to the 30 s cap
+    prod = dataclasses.replace(
+        Fleet(
+            RokoConfig(fleet=FleetConfig(workers=1)),
+            stub_command,
+            runtime_dir=str(tmp_path / "prod"),
+            log=lambda m: None,
+        ).restart_policy,
+        jitter=0.0,
+    )
+    assert [prod.delay_for(k) for k in range(1, 9)] == [
+        0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 30.0, 30.0,
+    ]
+    # jittered delays stay within +10%
+    noisy = fleet.restart_policy.delay_for(2)
+    assert 0.1 <= noisy <= 0.1 * 1.1 + 1e-9
+
+
+def test_note_death_schedules_backoff(tmp_path):
+    fleet = make_fleet(tmp_path)
+    w = fleet.workers[0]
+    fleet._note_death(w, 100.0, "test")
+    assert w.state == DEAD
+    assert w.attempt == 1
+    assert w.restart_at >= 100.0 + 0.05
+    fleet._note_death(w, 200.0, "test")
+    assert w.attempt == 2
+    assert w.restart_at >= 200.0 + 0.1
+
+
+def test_fleet_worker_slice_and_env(monkeypatch):
+    assert fleet_worker_slice(0, 4, 2) == [0, 1]
+    assert fleet_worker_slice(3, 4, 2) == [6, 7]
+    with pytest.raises(ValueError, match="outside fleet"):
+        fleet_worker_slice(4, 4, 2)
+    with pytest.raises(ValueError, match="devices_per_worker"):
+        fleet_worker_slice(0, 4, 0)
+    assert fleet_worker_env(1, 2, 2, backend="tpu") == {
+        "TPU_VISIBLE_DEVICES": "2,3"
+    }
+    assert fleet_worker_env(0, 2, 4, backend="gpu") == {
+        "CUDA_VISIBLE_DEVICES": "0,1,2,3"
+    }
+    # cpu: per-process virtual device count, stale count stripped
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_foo --xla_force_host_platform_device_count=8"
+    )
+    env = fleet_worker_env(1, 2, 4, backend="cpu")
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert "count=8" not in env["XLA_FLAGS"]
+    assert "--xla_foo" in env["XLA_FLAGS"]
+    # unpinned: empty overlay, workers see everything
+    assert fleet_worker_env(0, 2, 0, backend="tpu") == {}
+
+
+def test_cli_workers_flag_layers_into_config():
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "ckpt/", "--workers", "2", "--devices-per-worker", "4",
+         "--heartbeat-interval", "0.5"]
+    )
+    cfg = _build_config(args)
+    assert cfg.fleet.workers == 2
+    assert cfg.fleet.devices_per_worker == 4
+    assert cfg.fleet.heartbeat_interval_s == 0.5
+    # defaults: no fleet
+    default = _build_config(build_parser().parse_args(["serve", "ckpt/"]))
+    assert default.fleet.workers == 0
+    # fleet section survives the config JSON round trip
+    assert RokoConfig.from_json(cfg.to_json()).fleet == cfg.fleet
+
+
+def test_parse_metric_values():
+    text = (
+        "# TYPE a counter\na 3\nb 4.5\n"
+        'labeled{x="1"} 9\nmalformed line here\n'
+    )
+    assert parse_metric_values(text, ("a", "b", "labeled")) == {
+        "a": "3", "b": "4.5",
+    }
+
+
+def test_client_retry_exhaustion_is_typed():
+    """Exhausting the retry budget against 503s raises the typed
+    ServiceUnavailable (a ServerBusy subclass, so existing handlers
+    keep working) carrying the attempt count."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Busy(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            body = json.dumps({"error": "busy", "retry_after_s": 2.5}).encode()
+            self.send_response(503)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Busy)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        client = PolishClient(f"http://127.0.0.1:{srv.server_address[1]}")
+        client._sleep = lambda s: None
+        with pytest.raises(ServiceUnavailable) as exc:
+            post(client, retries=2)
+        assert exc.value.attempts == 3
+        assert exc.value.retry_after_s == 2.5
+        assert isinstance(exc.value, ServerBusy)
+        assert "3 attempt(s)" in str(exc.value)
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        t.join(5.0)
+
+
+# -- supervision with real (stub) processes ----------------------------------
+
+
+def test_fleet_routes_and_aggregates(tmp_path):
+    """Happy path: two workers spawn, announce, enter rotation; the
+    front end routes /polish, aggregates /healthz, and re-exports
+    per-worker gauges labeled by worker id."""
+    fleet = make_fleet(tmp_path, workers=2)
+    fleet.start()
+    server = thread = None
+    try:
+        wait_until(lambda: fleet.ready_count() == 2, msg="2 workers ready")
+        server, thread = start_front(fleet)
+        port = server.server_address[1]
+        code, health = get_json(port, "/healthz")
+        assert code == 200
+        assert health["status"] == "ok"
+        assert health["workers_up"] == 2
+        assert health["workers"]["0"]["state"] == READY
+        client = PolishClient(f"http://127.0.0.1:{port}")
+        reply = post(client)
+        assert reply["polished"].startswith("STUB-")
+        assert reply["windows"] == 1
+        text = client.metrics()
+        assert "roko_fleet_workers 2" in text
+        assert "roko_fleet_workers_up 2" in text
+        assert "roko_fleet_requests_total 1" in text
+        assert "roko_fleet_restarts_total 0" in text
+        # per-worker passthrough, labeled by worker id
+        assert 'roko_serve_breaker_state{worker="0"} 0' in text
+        assert 'roko_serve_breaker_trips_total{worker="1"} 1' in text
+        assert 'roko_compile_cache_hits{worker="0"} 5' in text
+        assert 'roko_fleet_worker_state{worker="1"} 0' in text
+    finally:
+        if server is not None:
+            stop_front(server, thread)
+        fleet.stop(rolling=False)
+    assert all(w.state == STOPPED for w in fleet.workers)
+    assert all(not w.alive() for w in fleet.workers)
+
+
+def test_fleet_restarts_crashed_worker(tmp_path):
+    """SIGKILL a worker: waitpid notices, the restart lands after
+    backoff, the replacement announces a fresh port and rejoins."""
+    fleet = make_fleet(tmp_path, workers=2)
+    fleet.start()
+    try:
+        wait_until(lambda: fleet.ready_count() == 2, msg="2 workers ready")
+        w0 = fleet.workers[0]
+        pid0 = w0.proc.pid
+        w0.proc.kill()
+        wait_until(
+            lambda: fleet.counter("restarts") >= 1, msg="restart counted"
+        )
+        wait_until(lambda: fleet.ready_count() == 2, msg="worker rejoined")
+        assert w0.proc.pid != pid0
+        assert w0.restarts == 1
+        # the replacement eventually counts as stable and the backoff
+        # schedule resets
+        wait_until(lambda: w0.stable, msg="replacement stable")
+        assert w0.attempt == 0
+    finally:
+        fleet.stop(rolling=False)
+
+
+def test_fleet_failover_worker_death_midrequest(tmp_path):
+    """Worker 0 dies mid-request without replying (os._exit inside the
+    handler): the front end retries on worker 1 transparently — every
+    client call still returns 200 and the failover is counted."""
+    fleet = make_fleet(
+        tmp_path,
+        workers=2,
+        env_for=lambda wid: (
+            {"STUB_CRASH_ON_POLISH": "1"} if wid == 0 else {}
+        ),
+    )
+    fleet.start()
+    server = thread = None
+    try:
+        wait_until(lambda: fleet.ready_count() == 2, msg="2 workers ready")
+        server, thread = start_front(fleet)
+        client = PolishClient(f"http://127.0.0.1:{server.server_address[1]}")
+        for _ in range(4):
+            reply = post(client)
+            # every reply came from the healthy worker
+            assert reply["polished"] == f"STUB-{fleet.workers[1].proc.pid}"
+        assert fleet.counter("failovers") >= 1
+    finally:
+        if server is not None:
+            stop_front(server, thread)
+        fleet.stop(rolling=False)
+
+
+def test_fleet_storm_breaker_degrades_not_flaps(tmp_path):
+    """A worker that dies at every start trips its restart-storm
+    breaker after storm_threshold deaths: it is marked FAILED (no more
+    respawn attempts until the breaker's reset) and the fleet reports
+    degraded-but-serving on the survivor."""
+    fleet = make_fleet(
+        tmp_path,
+        workers=2,
+        env_for=lambda wid: ({"STUB_FAIL_START": "1"} if wid == 1 else {}),
+        storm_threshold=2,
+        storm_reset_s=3600.0,
+    )
+    fleet.start()
+    try:
+        wait_until(lambda: fleet.ready_count() == 1, msg="worker 0 ready")
+        w1 = fleet.workers[1]
+        wait_until(lambda: w1.state == FAILED, msg="storm breaker opens")
+        restarts_then = w1.restarts
+        assert restarts_then >= 1  # it did try before giving up
+        time.sleep(0.5)  # many would-be backoff periods
+        assert w1.restarts == restarts_then  # no flapping
+        assert w1.state == FAILED
+        summary = fleet.summary()
+        assert summary["status"] == "degraded"
+        assert summary["code"] == 200
+        assert summary["workers_up"] == 1
+    finally:
+        fleet.stop(rolling=False)
+
+
+def test_fleet_hung_worker_killed_and_restarted(tmp_path):
+    """A worker whose process is alive but stops answering /healthz is
+    declared hung after heartbeat_misses unanswered probes, killed
+    (SIGTERM->SIGKILL escalation), and restarted."""
+    fleet = make_fleet(
+        tmp_path,
+        workers=1,
+        env_for=lambda wid: {"STUB_HANG_AFTER_S": "0.4"},
+        heartbeat_timeout_s=0.3,
+        heartbeat_misses=2,
+        term_grace_s=0.5,
+    )
+    fleet.start()
+    try:
+        wait_until(
+            lambda: fleet.workers[0].restarts >= 1,
+            msg="hung worker killed and restarted",
+        )
+    finally:
+        fleet.stop(rolling=False)
+
+
+def test_front_sheds_when_no_worker_ready(tmp_path):
+    """All workers warming: /healthz says warming (503) and /polish is
+    shed with 503 + Retry-After; the typed ServiceUnavailable surfaces
+    once the client's retry budget is gone."""
+    fleet = make_fleet(
+        tmp_path, workers=1, env_for=lambda wid: {"STUB_WARM_S": "60"}
+    )
+    fleet.start()
+    server = thread = None
+    try:
+        wait_until(
+            lambda: fleet.workers[0].state == WARMING, msg="worker warming"
+        )
+        server, thread = start_front(fleet)
+        port = server.server_address[1]
+        code, health = get_json(port, "/healthz")
+        assert code == 503
+        assert health["status"] == "warming"
+        client = PolishClient(f"http://127.0.0.1:{port}")
+        client._sleep = lambda s: None
+        with pytest.raises(ServerBusy):
+            post(client, retries=0)
+        with pytest.raises(ServiceUnavailable) as exc:
+            post(client, retries=1)
+        assert exc.value.attempts == 2
+    finally:
+        if server is not None:
+            stop_front(server, thread)
+        fleet.stop(rolling=False)
+
+
+def test_rolling_drain_zero_dropped_inflight(tmp_path):
+    """SIGTERM semantics: requests in flight when the drain begins ALL
+    complete with 200 (front end finishes its relays before workers are
+    touched; workers then drain one at a time); new work is refused."""
+    fleet = make_fleet(
+        tmp_path,
+        workers=2,
+        env_for=lambda wid: {"STUB_POLISH_DELAY_S": "0.6"},
+    )
+    fleet.start()
+    server = thread = None
+    try:
+        wait_until(lambda: fleet.ready_count() == 2, msg="2 workers ready")
+        server, thread = start_front(fleet)
+        port = server.server_address[1]
+        client = PolishClient(f"http://127.0.0.1:{port}")
+        results = []
+        errors = []
+
+        def one():
+            try:
+                results.append(post(client, retries=0))
+            except Exception as e:  # anything non-200 is a drop
+                errors.append(repr(e))
+
+        clients = [
+            threading.Thread(target=one, daemon=True) for _ in range(4)
+        ]
+        for t in clients:
+            t.start()
+        time.sleep(0.25)  # all four are now in flight (0.6 s polish)
+        rolling_drain(server, fleet, log=lambda m: None)
+        for t in clients:
+            t.join(15.0)
+        assert errors == []
+        assert len(results) == 4
+        assert all(r["polished"].startswith("STUB-") for r in results)
+        # fleet is gone: workers exited, new connections refused
+        assert all(not w.alive() for w in fleet.workers)
+        server.server_close()
+        with pytest.raises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=2
+            )
+    finally:
+        fleet.stop(rolling=False)  # idempotent
+        if thread is not None:
+            thread.join(5.0)
+
+
+def test_front_admission_control(tmp_path):
+    """In-flight relays past the fleet's aggregate capacity are shed at
+    the front door with 503 + Retry-After and counted as rejected."""
+    fleet = make_fleet(
+        tmp_path,
+        workers=1,
+        env_for=lambda wid: {"STUB_POLISH_DELAY_S": "0.8"},
+    )
+    fleet.max_inflight = 2  # tiny cap so the third request trips it
+    fleet.start()
+    server = thread = None
+    try:
+        wait_until(lambda: fleet.ready_count() == 1, msg="worker ready")
+        server, thread = start_front(fleet)
+        port = server.server_address[1]
+        client = PolishClient(f"http://127.0.0.1:{port}")
+        done = []
+        hold = [
+            threading.Thread(
+                target=lambda: done.append(post(client, retries=4)),
+                daemon=True,
+            )
+            for _ in range(2)
+        ]
+        for t in hold:
+            t.start()
+        wait_until(
+            lambda: server._inflight >= 2, timeout=5.0, msg="relays in flight"
+        )
+        shed = PolishClient(f"http://127.0.0.1:{port}")
+        shed._sleep = lambda s: None
+        with pytest.raises(ServerBusy):
+            post(shed, retries=0)
+        assert fleet.counter("rejected") >= 1
+        for t in hold:
+            t.join(15.0)
+        assert len(done) == 2
+    finally:
+        if server is not None:
+            stop_front(server, thread)
+        fleet.stop(rolling=False)
+
+
+# -- real-worker acceptance (slow) -------------------------------------------
+
+TINY = dict(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+def _real_fleet_setup(tmp_path, workers=2, use_bundle=True):
+    """Checkpoint + shared worker config (+ AOT bundle) for a fleet of
+    real ``roko-tpu serve`` subprocess workers on the tiny model."""
+    import jax
+
+    from roko_tpu.compile import export_bundle
+    from roko_tpu.config import MeshConfig, ModelConfig
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.serve.supervisor import worker_command
+    from roko_tpu.training.checkpoint import save_params
+
+    cfg = RokoConfig(
+        model=ModelConfig(**TINY),
+        mesh=MeshConfig(dp=8),
+        serve=ServeConfig(ladder=(8,), max_delay_ms=5.0),
+        fleet=fast_fleet_cfg(
+            workers,
+            heartbeat_interval_s=0.25,
+            spawn_deadline_s=60.0,
+            stable_after_s=1.0,
+        ),
+    )
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    save_params(ckpt, params)
+    if use_bundle:
+        bundle = str(tmp_path / "bundle")
+        export_bundle(bundle, cfg, ladder=(8,), log=lambda m: None)
+        cfg = dataclasses.replace(
+            cfg, compile=dataclasses.replace(cfg.compile, bundle_dir=bundle)
+        )
+    cfg_path = str(tmp_path / "worker-config.json")
+    with open(cfg_path, "w") as f:
+        f.write(
+            dataclasses.replace(
+                cfg, fleet=dataclasses.replace(cfg.fleet, workers=0)
+            ).to_json()
+        )
+    fleet = Fleet(
+        cfg,
+        worker_command(ckpt, cfg_path),
+        runtime_dir=str(tmp_path / "fleet"),
+        log=lambda m: None,
+    )
+    return cfg, params, fleet
+
+
+def _serve_windows(rng, n, cols=90, stride=30):
+    from roko_tpu import constants as C
+
+    x = rng.integers(0, C.FEATURE_VOCAB, (n, 200, cols)).astype(np.uint8)
+    positions = np.zeros((n, cols, 2), np.int64)
+    for i in range(n):
+        positions[i, :, 0] = np.arange(i * stride, i * stride + cols)
+    return positions, x
+
+
+@pytest.mark.slow
+def test_fleet_sigkill_midload_byte_identical(tmp_path, rng):
+    """The acceptance bar: with 2 real workers under load, SIGKILL one
+    mid-run — zero client-visible failures, every reply byte-identical
+    to the single-process inference path, and the killed worker rejoins
+    rotation after re-warming from the AOT bundle."""
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.infer import run_inference
+
+    cfg, params, fleet = _real_fleet_setup(tmp_path, workers=2)
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    positions, x = _serve_windows(rng, 7)
+
+    path = tmp_path / "infer.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", list(positions), list(x), None)
+    expected = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None
+    )["ctg"]
+
+    fleet.start()
+    server = thread = None
+    try:
+        wait_until(
+            lambda: fleet.ready_count() == 2, timeout=180.0,
+            msg="2 real workers warm",
+        )
+        server, thread = start_front(fleet)
+        port = server.server_address[1]
+        replies = []
+        errors = []
+        killed = threading.Event()
+
+        def one_client():
+            client = PolishClient(f"http://127.0.0.1:{port}", timeout=120.0)
+            for _ in range(8):
+                try:
+                    replies.append(
+                        client.polish(
+                            draft, positions, x, contig="ctg", retries=8
+                        )
+                    )
+                except Exception as e:
+                    errors.append(repr(e))
+                if len(replies) >= 4 and not killed.is_set():
+                    killed.set()
+                    fleet.workers[0].proc.kill()  # SIGKILL mid-load
+
+        clients = [
+            threading.Thread(target=one_client, daemon=True)
+            for _ in range(2)
+        ]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join(300.0)
+        assert killed.is_set()
+        assert errors == []  # zero client-visible failures
+        assert len(replies) == 16
+        for r in replies:
+            assert r["polished"] == expected  # byte-identical, every time
+        # the killed worker re-warms (AOT bundle) and rejoins rotation
+        wait_until(
+            lambda: fleet.ready_count() == 2, timeout=180.0,
+            msg="killed worker rejoined",
+        )
+        assert fleet.counter("restarts") >= 1
+        code, health = get_json(port, "/healthz")
+        assert code == 200 and health["status"] == "ok"
+    finally:
+        if server is not None:
+            stop_front(server, thread)
+        fleet.stop(rolling=False)
+
+
+@pytest.mark.slow
+def test_cli_supervisor_sigterm_drains_clean(tmp_path, rng):
+    """`roko-tpu serve --workers 2` end to end through the CLI: the
+    supervisor announces its front-end port, serves a polish request
+    routed to a real worker, and a SIGTERM rolls the whole fleet down
+    cleanly (rc 0, no surviving workers)."""
+    import signal
+    import subprocess
+
+    cfg, params, fleet = _real_fleet_setup(tmp_path, workers=2)
+    # the CLI builds its own Fleet; reuse the checkpoint/config from
+    # the helper and drop the pre-built one
+    ckpt = str(tmp_path / "ckpt")
+    sup_cfg_path = str(tmp_path / "supervisor-config.json")
+    with open(sup_cfg_path, "w") as f:
+        f.write(cfg.to_json())  # fleet.workers=2 rides in the JSON
+    announce = str(tmp_path / "front.announce.json")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "roko_tpu", "serve", ckpt,
+         "--config", sup_cfg_path, "--port", "0",
+         "--announce", announce],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        wait_until(
+            lambda: os.path.exists(announce), timeout=60.0,
+            msg="supervisor announce",
+        )
+        with open(announce) as f:
+            port = json.load(f)["port"]
+        wait_until(
+            lambda: get_json(port, "/healthz")[1].get("status") == "ok",
+            timeout=180.0,
+            msg="fleet warm through the CLI",
+        )
+        positions, x = _serve_windows(rng, 3)
+        client = PolishClient(f"http://127.0.0.1:{port}", timeout=120.0)
+        draft = "".join(rng.choice(list("ACGT"), 500))
+        reply = client.polish(draft, positions, x, retries=8)
+        assert reply["windows"] == 3
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=120.0)
+        assert proc.returncode == 0, out[-2000:]
+        assert "rolling worker drain" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate(timeout=30.0)
