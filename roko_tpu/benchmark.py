@@ -30,7 +30,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -63,11 +63,14 @@ def _device_peak_flops() -> Optional[float]:
 
 
 def model_flops_per_window(cfg, *, training: bool = False) -> float:
-    """Analytic matmul FLOPs per window for the GRU consensus model.
-    Inference uses the one-hot reassociated embed+fc1 fast path; training
-    materialises the embedding via a one-hot GEMM (dropout sits between
-    embed and fc1) then contracts the read axis (models/model.py apply).
-    Backward pass counted as 2x forward for training."""
+    """Analytic matmul FLOPs per window for the recurrent consensus
+    models (``kind="gru"`` and ``kind="lingru"``). Inference uses the
+    one-hot reassociated embed+fc1 fast path; training materialises the
+    embedding via a one-hot GEMM (dropout sits between embed and fc1)
+    then contracts the read axis (models/model.py apply). Backward pass
+    counted as 2x forward for training. The lingru's elementwise
+    associative scan (O(T*H*log T) multiply-adds, no matmuls) is
+    omitted — it is noise next to the projections."""
     T, R, V = cfg.window_cols, cfg.window_rows, cfg.embed_vocab
     D = cfg.embed_dim
     J1, J2 = cfg.read_mlp
@@ -81,11 +84,18 @@ def model_flops_per_window(cfg, *, training: bool = False) -> float:
         # einsum brtv,rj + vd,btvj
         embed_fc1 = 2 * T * V * J1 * R + 2 * T * D * J1 * V
     fc2 = 2 * T * J1 * J2 * D
-    gru_in = 2 * T * gin * 6 * H  # both directions, layer 1
-    gru_in += (L - 1) * 2 * T * (2 * H) * 6 * H
-    gru_h = L * 2 * T * 2 * H * 3 * H
+    if cfg.kind == "lingru":
+        # gate projections only: [in, 2H] per direction, no hidden
+        # matmul anywhere (the recurrence is elementwise)
+        rec_in = 2 * T * gin * 4 * H  # both directions, layer 1
+        rec_in += (L - 1) * 2 * T * (2 * H) * 4 * H
+        rec_h = 0
+    else:
+        rec_in = 2 * T * gin * 6 * H  # both directions, layer 1
+        rec_in += (L - 1) * 2 * T * (2 * H) * 6 * H
+        rec_h = L * 2 * T * 2 * H * 3 * H
     head = 2 * T * 2 * H * cfg.num_classes
-    fwd = embed_fc1 + fc2 + gru_in + gru_h + head
+    fwd = embed_fc1 + fc2 + rec_in + rec_h + head
     return fwd * (3.0 if training else 1.0)
 
 
@@ -130,6 +140,80 @@ def bench_infer(
     np.asarray(outs[-1])
     dt = time.perf_counter() - t0
     return batch * iters / dt
+
+
+def bench_recurrence(kind: str, batch: int, iters: int) -> float:
+    """windows/sec of the RECURRENCE stack alone ([B,T,gru_in] ->
+    [B,T,2H], full-size dims, float32): isolates the log-depth
+    associative-scan win from the front end + head the kinds share.
+    The whole-model per-kind rows are the acceptance metric; this row
+    explains them — on hosts where the (kind-independent) front end
+    dominates, the whole-model ratio is Amdahl-capped well below the
+    recurrence-only ratio, while on TPU the serial GRU chain is nearly
+    the whole predict step (ROADMAP item 1)."""
+    import jax
+
+    from roko_tpu.config import ModelConfig
+    from roko_tpu.models.gru import RokoGRU, bidir_gru_stack
+    from roko_tpu.models.lingru import RokoLinGRU, bidir_lingru_stack
+
+    cfg = ModelConfig()
+    if kind == "lingru":
+        mod = RokoLinGRU(cfg.gru_in_size, cfg.hidden_size, cfg.num_layers, 0.0)
+        stack = bidir_lingru_stack
+    else:
+        mod = RokoGRU(cfg.gru_in_size, cfg.hidden_size, cfg.num_layers, 0.0)
+        stack = bidir_gru_stack
+    params = mod.init(jax.random.PRNGKey(0))
+    step = jax.jit(lambda p, x: stack(p, x))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(
+        (batch, cfg.window_cols, cfg.gru_in_size)
+    ).astype(np.float32)
+    x = jax.device_put(x)
+    for _ in range(WARMUP):
+        np.asarray(step(params, x))
+    t0 = time.perf_counter()
+    outs = [step(params, x) for _ in range(iters)]
+    np.asarray(outs[-1])
+    return batch * iters / (time.perf_counter() - t0)
+
+
+def bench_precision(
+    kind: str, batch: int, iters: int, model_overrides: Optional[Dict] = None
+) -> Dict[str, Any]:
+    """The compute-dtype precision column (seeds ROADMAP item 4): f32 vs
+    bf16 windows/sec on identical work, plus the max-abs logit delta
+    between the two dtypes on one shared (params, batch) — the cheap
+    accuracy-drift bound a held-out Q check would refine. bf16 rides the
+    MXU on TPU but is EMULATED on CPU, so a CPU artifact can honestly
+    show bf16 *slower*; ``env.backend`` disambiguates."""
+    import jax
+    import jax.numpy as jnp
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import ModelConfig
+    from roko_tpu.models.model import RokoModel
+
+    over = model_overrides or {}
+    cfg32 = ModelConfig(kind=kind, compute_dtype="float32", **over)
+    cfgbf = ModelConfig(kind=kind, compute_dtype="bfloat16", **over)
+    row: Dict[str, Any] = {"model_kind": kind, "batch": batch}
+    row["f32_windows_per_sec"] = round(bench_infer(cfg32, batch, iters), 1)
+    row["bf16_windows_per_sec"] = round(bench_infer(cfgbf, batch, iters), 1)
+    m32, mbf = RokoModel(cfg32), RokoModel(cfgbf)
+    params = m32.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = rng.integers(
+        0, C.FEATURE_VOCAB,
+        (min(batch, 16), cfg32.window_rows, cfg32.window_cols),
+    ).astype(np.uint8)
+    delta = jnp.abs(
+        m32.apply(params, x, deterministic=True)
+        - mbf.apply(params, x, deterministic=True)
+    )
+    row["max_abs_logit_delta"] = round(float(delta.max()), 5)
+    return row
 
 
 def bench_train(
@@ -289,6 +373,78 @@ def run_inference_suite(
             best, best_batch = top, b
     if best == 0.0:
         raise RuntimeError(f"all inference paths failed: {sweep}")
+
+    # -- per-kind recurrence rows (ISSUE 8): torch-exact GRU vs the
+    # associative-scan linear GRU on IDENTICAL fixed work (same batch,
+    # same pinned iteration count), each row carrying its model_kind.
+    b0 = batches[0]
+    first = sweep[str(b0)]
+    kinds: Dict[str, Any] = {}
+    detail["model_kinds"] = kinds
+    gru_row: Dict[str, Any] = {
+        "model_kind": "gru", "batch": b0, "iterations": iters,
+    }
+    if "scan" in first:
+        # the sweep's scan row IS the gru measurement (same config,
+        # batch, and iteration count) — reuse it rather than paying a
+        # duplicate full measurement
+        gru_row["scan_windows_per_sec"] = first["scan"]
+    else:
+        gru_row["error"] = first.get("scan_error", "scan row failed")
+    kinds["gru"] = gru_row
+    lin_row: Dict[str, Any] = {
+        "model_kind": "lingru", "batch": b0, "iterations": iters,
+    }
+    try:
+        d_l: Dict[str, Any] = {}
+        lin_row["scan_windows_per_sec"] = round(
+            bench_infer(
+                ModelConfig(kind="lingru", compute_dtype="bfloat16"),
+                b0, iters, detail=d_l,
+            ),
+            1,
+        )
+        lin_row["warmup_seconds"] = d_l.get("warmup_seconds")
+    except Exception as e:  # report, never swallow
+        lin_row["error"] = f"{type(e).__name__}: {e}"[:300]
+    kinds["lingru"] = lin_row
+    if progress is not None:
+        progress(detail)
+    if "scan_windows_per_sec" in gru_row and "scan_windows_per_sec" in lin_row:
+        detail["lingru_speedup_vs_gru"] = round(
+            lin_row["scan_windows_per_sec"] / gru_row["scan_windows_per_sec"],
+            2,
+        )
+    # recurrence-isolated A/B: the log-depth win without the shared
+    # front end diluting it (whole-model rows above stay the headline)
+    try:
+        rec_g = bench_recurrence("gru", b0, iters)
+        rec_l = bench_recurrence("lingru", b0, iters)
+        detail["recurrence_only"] = {
+            "batch": b0,
+            "iterations": iters,
+            "gru_windows_per_sec": round(rec_g, 1),
+            "lingru_windows_per_sec": round(rec_l, 1),
+            "lingru_speedup_vs_gru": round(rec_l / rec_g, 2),
+        }
+    except Exception as e:  # report, never swallow
+        detail["recurrence_only"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if progress is not None:
+        progress(detail)
+
+    # -- precision column (seeds ROADMAP item 4): f32 vs bf16 per kind +
+    # max-abs logit delta, at a bounded batch so the column can't eat
+    # the suite's budget on emulating-bf16 hosts
+    prec: Dict[str, Any] = {}
+    detail["precision"] = prec
+    for kind in ("gru", "lingru"):
+        try:
+            prec[kind] = bench_precision(kind, min(b0, 128), iters)
+        except Exception as e:  # report, never swallow
+            prec[kind] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        if progress is not None:
+            progress(detail)
+
     hits1, misses1 = cache_counters()
     # cold-start trajectory rider: whether this round's compiles came
     # from disk (persistent cache) or paid XLA, next to the throughput
@@ -297,7 +453,6 @@ def run_inference_suite(
         "hits": hits1 - hits0,
         "misses": misses1 - misses0,
     }
-    first = sweep[str(batches[0])]
     if "scan" in first:
         detail["scan_windows_per_sec"] = first["scan"]
     if "pallas" in first:
@@ -669,6 +824,93 @@ def _assemble_result(
         "vs_baseline": round(windows_per_sec / ref_windows_per_sec, 2),
         "detail": detail,
     }
+
+
+#: cross-round deltas inside this band are flagged ``noise``, never
+#: regressions: scan_windows_per_sec moved 117.5 -> 93.4 between r04 and
+#: r05 with no plausible code cause (the torch CPU reference moved the
+#: same direction) — single-digit-% moves on a shared noisy box track
+#: the box, not the code (ROADMAP watch item 6)
+NOISE_BAND_PCT = 10.0
+
+
+def compare_to_previous(
+    result: Dict[str, Any],
+    prev: Dict[str, Any],
+    noise_band_pct: float = NOISE_BAND_PCT,
+) -> Dict[str, Any]:
+    """Attach a ``detail.vs_previous`` block comparing this artifact's
+    headline metrics (incl. the per-kind ``model_kinds`` rows and the
+    cross-round ``vs_baseline`` ratio) against a previous BENCH_*.json.
+    Each metric reports current/previous/delta_pct plus ``noise: true``
+    when the delta sits inside the noise band; only a drop BEYOND the
+    band is marked ``regression``."""
+    cur_d = result.get("detail") or {}
+    prev_d = prev.get("detail") or {}
+    pairs: Dict[str, Tuple[Any, Any]] = {
+        "value": (result.get("value"), prev.get("value")),
+        "vs_baseline": (result.get("vs_baseline"), prev.get("vs_baseline")),
+        "windows_per_sec": (
+            cur_d.get("windows_per_sec"), prev_d.get("windows_per_sec"),
+        ),
+        "scan_windows_per_sec": (
+            cur_d.get("scan_windows_per_sec"),
+            prev_d.get("scan_windows_per_sec"),
+        ),
+        "pallas_windows_per_sec": (
+            cur_d.get("pallas_windows_per_sec"),
+            prev_d.get("pallas_windows_per_sec"),
+        ),
+    }
+    for kind, row in (cur_d.get("model_kinds") or {}).items():
+        prow = (prev_d.get("model_kinds") or {}).get(kind) or {}
+        pairs[f"model_kinds.{kind}.scan_windows_per_sec"] = (
+            (row or {}).get("scan_windows_per_sec"),
+            prow.get("scan_windows_per_sec"),
+        )
+    metrics: Dict[str, Any] = {}
+    for name, (cur, old) in pairs.items():
+        if (
+            not isinstance(cur, (int, float))
+            or not isinstance(old, (int, float))
+            or not old
+        ):
+            continue
+        delta_pct = 100.0 * (cur - old) / old
+        row = {
+            "current": cur,
+            "previous": old,
+            "delta_pct": round(delta_pct, 2),
+            "noise": abs(delta_pct) < noise_band_pct,
+        }
+        if delta_pct <= -noise_band_pct:
+            row["regression"] = True
+        metrics[name] = row
+    # comparisons are only interpretable on identical fixed work: record
+    # both sides' pinned iteration counts so a mismatch is visible
+    block = {
+        "noise_band_pct": noise_band_pct,
+        "iterations": cur_d.get("iterations"),
+        "previous_iterations": prev_d.get("iterations"),
+        "metrics": metrics,
+    }
+    result.setdefault("detail", {})["vs_previous"] = block
+    return block
+
+
+def _apply_compare(result: Dict[str, Any], compare_path: str) -> None:
+    """Best-effort ``--compare``: an unreadable previous artifact is
+    reported inside the result, never allowed to void it."""
+    try:
+        with open(compare_path) as f:
+            prev = json.load(f)
+    except (OSError, ValueError) as e:
+        result.setdefault("detail", {})["vs_previous"] = {
+            "error": f"could not read {compare_path!r}: {e}"[:300]
+        }
+        return
+    block = compare_to_previous(result, prev)
+    block["file"] = compare_path
 
 
 def _git_rev() -> str:
@@ -1498,6 +1740,17 @@ def main(argv=None) -> None:
         "item 6)",
     )
     ap.add_argument(
+        "--compare",
+        default=None,
+        metavar="BENCH_JSON",
+        help="previous BENCH_*.json to compare against: adds a "
+        "detail.vs_previous block with per-metric deltas where moves "
+        f"inside the {NOISE_BAND_PCT:.0f}%% band are flagged noise=true, "
+        "not regressions, and defaults the run to fixed-work "
+        "--bench-iterations so the delta compares identical work "
+        "(ROADMAP watch item 6)",
+    )
+    ap.add_argument(
         "--in-process",
         action="store_true",
         help="measure in this process (no probe/fallback orchestration); "
@@ -1505,6 +1758,10 @@ def main(argv=None) -> None:
         "parse even when the TPU relay is wedged",
     )
     args = ap.parse_args(argv)
+    if args.compare and args.bench_iterations is None:
+        # a cross-round comparison is only interpretable on identical
+        # fixed work: pin (and record) the iteration count by default
+        args.bench_iterations = ITERS
 
     log = lambda msg: print(msg, file=sys.stderr, flush=True)  # noqa: E731
 
@@ -1514,7 +1771,10 @@ def main(argv=None) -> None:
     # chip with no env set — the sick-backend probe/fallback must wrap
     # the measurement, because a wedged backend HANGS in-process init.
     if args.in_process or os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        _emit(_measure(args), args.out)
+        result = _measure(args)
+        if args.compare:
+            _apply_compare(result, args.compare)
+        _emit(result, args.out)
         return
 
     try:
@@ -1539,6 +1799,8 @@ def main(argv=None) -> None:
                 platform=platform or "unknown",
             )
             if result is not None:
+                if args.compare:
+                    _apply_compare(result, args.compare)
                 _emit(result, args.out)
                 return
             why = (
@@ -1558,6 +1820,8 @@ def main(argv=None) -> None:
         args.features = True
         result = _measure(args)
         result["detail"].setdefault("env", {})["tpu_error"] = why[:600]
+        if args.compare:
+            _apply_compare(result, args.compare)
         _emit(result, args.out)
     except Exception as e:  # absolute last resort: the artifact must parse
         _emit(

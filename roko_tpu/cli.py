@@ -33,7 +33,12 @@ def _mesh_args(p: argparse.ArgumentParser) -> None:
 
 
 def _model_args(p: argparse.ArgumentParser) -> None:
-    p.add_argument("--model-kind", choices=("gru", "transformer"), default=None)
+    p.add_argument(
+        "--model-kind", choices=("gru", "lingru", "transformer"), default=None,
+        help="recurrence family: gru (torch-exact reference), lingru "
+        "(associative-scan linear recurrence — log-depth inference; "
+        "README 'Model kinds'), transformer",
+    )
     p.add_argument("--hidden-size", type=int, default=None)
     p.add_argument("--num-layers", type=int, default=None)
     p.add_argument("--compute-dtype", default=None, choices=("float32", "bfloat16"))
@@ -386,6 +391,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--bench-iterations", str(args.bench_iterations)]
     if args.fleet_workers is not None:
         argv += ["--fleet-workers", args.fleet_workers]
+    if args.compare is not None:
+        argv += ["--compare", args.compare]
     if args.in_process:
         argv.append("--in-process")
     bench_main(argv)
@@ -515,7 +522,8 @@ def cmd_compile(args: argparse.Namespace) -> int:
     manifest = export_bundle(args.out, cfg, ladder=sorted(rungs))
     print(
         f"compile: wrote bundle {args.out} "
-        f"(rungs {manifest['rungs']}, digest {manifest['digest'][:12]})"
+        f"(kind {cfg.model.kind}, rungs {manifest['rungs']}, "
+        f"digest {manifest['digest'][:12]})"
     )
     if not args.no_verify:
         with tempfile.NamedTemporaryFile(
@@ -817,7 +825,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = sub.add_parser(
         "compile",
         help="pre-compile the predict ladder into an AOT executable "
-        "bundle (load with serve/polish/inference --bundle)",
+        "bundle (load with serve/polish/inference --bundle); bundles "
+        "are per model kind — the identity digest covers --model-kind, "
+        "so a gru bundle refuses to load into a lingru session",
     )
     p.add_argument("out", help="bundle output directory")
     p.add_argument(
@@ -884,6 +894,13 @@ def build_parser() -> argparse.ArgumentParser:
         "(req/s + p99 per count, scaling efficiency, req/s during a "
         "forced worker SIGKILL; default 1,2 when the e2e suite runs; "
         "0 disables)",
+    )
+    p.add_argument(
+        "--compare", default=None, metavar="BENCH_JSON",
+        help="previous BENCH_*.json to diff against: adds "
+        "detail.vs_previous with noise=true for deltas inside the "
+        "noise band, and defaults to fixed-work --bench-iterations "
+        "(ROADMAP watch item 6)",
     )
     p.add_argument(
         "--in-process",

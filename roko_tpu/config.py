@@ -53,11 +53,17 @@ class RegionConfig:
     overlap: int = C.REGION_OVERLAP
 
 
+#: valid ``ModelConfig.kind`` values: "gru" is the torch-exact reference
+#: recurrence, "lingru" the associative-scan linear recurrence (log-depth
+#: inference, models/lingru.py), "transformer" the attention variant
+MODEL_KINDS = ("gru", "lingru", "transformer")
+
+
 @dataclass(frozen=True)
 class ModelConfig:
     """Model family + dimensions (ref: roko/rnn_model.py:10-12,24-44)."""
 
-    kind: str = "gru"  # "gru" | "transformer"
+    kind: str = "gru"  # one of MODEL_KINDS
     embed_vocab: int = C.FEATURE_VOCAB
     #: window geometry the model consumes — kept in ModelConfig (not just
     #: WindowConfig) because it sizes fc1 and the positional table; the
@@ -93,6 +99,15 @@ class ModelConfig:
     # strategy. Off by default until the driver-measured bench row
     # (train_gru_remat_scan) proves it on chip.
     remat_scan: bool = False
+
+    def __post_init__(self) -> None:
+        # validate at construction (config layering, JSON load, CLI) so a
+        # typo'd kind fails where it was written, not at first init/apply
+        if self.kind not in MODEL_KINDS:
+            raise ValueError(
+                f"unknown model kind {self.kind!r}; expected one of "
+                + "|".join(MODEL_KINDS)
+            )
 
     @property
     def gru_in_size(self) -> int:

@@ -1,10 +1,13 @@
 from roko_tpu.models.gru import RokoGRU, bidir_gru_stack
+from roko_tpu.models.lingru import RokoLinGRU, bidir_lingru_stack
 from roko_tpu.models.model import RokoModel, build_model, init_params
 
 __all__ = [
     "RokoGRU",
+    "RokoLinGRU",
     "RokoModel",
     "bidir_gru_stack",
+    "bidir_lingru_stack",
     "build_model",
     "init_params",
 ]

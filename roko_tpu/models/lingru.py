@@ -1,0 +1,209 @@
+"""Log-depth linear recurrence: the ``kind="lingru"`` model variant.
+
+The torch-exact GRU (``roko_tpu/models/gru.py``) is the accuracy/byte-
+identity reference, but its recurrence is *nonlinear in h* — every
+timestep needs the previous hidden state before its gates can be
+computed, so inference serialises T=90 tiny [B,H]x[H,3H] matmuls while
+the MXU idles (ROADMAP item 1: 31.7 s of the 32.9 s end-to-end polish).
+
+This module implements the GILR-style gated *linear* recurrence from
+"Parallelizing Linear Recurrent Neural Nets Over Sequence Length"
+(PAPERS.md): gates depend on the input only,
+
+    z_t = sigmoid(x_t @ W_z + b_z)
+    c_t = tanh   (x_t @ W_c + b_c)
+    h_t = (1 - z_t) * h_{t-1} + z_t * c_t
+
+so the recurrence is a first-order affine map ``h_t = a_t*h_{t-1} + b_t``
+with ``a_t = 1 - z_t`` and ``b_t = z_t * c_t``. Affine maps compose
+associatively — ``(a, b) o (a', b') = (a*a', a*b' + b)`` — which lets
+``jax.lax.associative_scan`` evaluate all T steps in O(log T) depth
+instead of T sequential steps. Everything with real arithmetic density
+(the gate projections) hoists out of the scan into one [B*T, in]x[in, 4H]
+MXU matmul per bidirectional layer; the scan itself is purely
+elementwise.
+
+Structure mirrors the GRU container exactly (bidirectional, multi-layer,
+fwd ++ bwd on the feature axis, inter-layer dropout), so
+``models/model.py`` swaps it in behind ``ModelConfig.kind`` with the
+same embed -> read-MLP front end and fc head. The associative-scan path
+is pinned against a naive per-step evaluation of the same recurrence
+(forward AND gradients) in ``tests/test_lingru.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from roko_tpu.models.layers import dropout as _dropout
+
+
+def lingru_layer_params(
+    rng: jax.Array, in_size: int, hidden: int, dtype=jnp.float32
+) -> Dict[str, jax.Array]:
+    """One direction of one layer. Orthogonal init for the gate
+    projections, standard normal for biases — the same scheme the GRU
+    layers use (``gru.gru_layer_params``) so the existing training
+    recipe transfers unchanged."""
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    ortho = jax.nn.initializers.orthogonal()
+    return {
+        "w_zx": ortho(k1, (in_size, hidden), dtype),
+        "w_cx": ortho(k2, (in_size, hidden), dtype),
+        "b_z": jax.random.normal(k3, (hidden,), dtype),
+        "b_c": jax.random.normal(k4, (hidden,), dtype),
+    }
+
+
+def lingru_gates(
+    params: Dict[str, jax.Array], x: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """``x`` [..., in] -> the affine-recurrence coefficients
+    ``(a, b)`` with ``h_t = a_t * h_{t-1} + b_t``. One fused [in, 2H]
+    matmul for both gates."""
+    hidden = params["w_zx"].shape[1]
+    w = jnp.concatenate([params["w_zx"], params["w_cx"]], axis=1)
+    bias = jnp.concatenate([params["b_z"], params["b_c"]])
+    proj = x @ w + bias
+    z = jax.nn.sigmoid(proj[..., :hidden])
+    c = jnp.tanh(proj[..., hidden:])
+    return 1.0 - z, z * c
+
+
+def linear_scan(a: jax.Array, b: jax.Array, axis: int = 1) -> jax.Array:
+    """All-timestep solution of ``h_t = a_t * h_{t-1} + b_t`` (h_0 = 0)
+    via ``lax.associative_scan`` over the affine composition — log-depth
+    in the scan axis instead of one step per element."""
+
+    def combine(left, right):
+        # left covers earlier timesteps; composed map = right AFTER left
+        a_l, b_l = left
+        a_r, b_r = right
+        return a_l * a_r, b_l * a_r + b_r
+
+    return lax.associative_scan(combine, (a, b), axis=axis)[1]
+
+
+def linear_scan_ref(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Naive per-step evaluation of the same recurrence over axis 1 —
+    the numerical oracle the associative-scan path is tested against
+    (tests/test_lingru.py), differentiable so gradient parity is checked
+    too. Never used on a hot path."""
+
+    def cell(h, ab):
+        a_t, b_t = ab
+        h = a_t * h + b_t
+        return h, h
+
+    h0 = jnp.zeros(a.shape[:1] + a.shape[2:], a.dtype)
+    _, hs = lax.scan(cell, h0, (a.swapaxes(0, 1), b.swapaxes(0, 1)))
+    return hs.swapaxes(0, 1)
+
+
+def lingru_direction(
+    params: Dict[str, jax.Array],
+    x: jax.Array,
+    reverse: bool = False,
+    *,
+    naive: bool = False,
+) -> jax.Array:
+    """Run one direction over ``x`` [B,T,in] -> [B,T,H]. ``naive=True``
+    substitutes the per-step reference scan (test oracle)."""
+    a, b = lingru_gates(params, x)
+    if reverse:
+        a, b = jnp.flip(a, axis=1), jnp.flip(b, axis=1)
+    h = linear_scan_ref(a, b) if naive else linear_scan(a, b, axis=1)
+    return jnp.flip(h, axis=1) if reverse else h
+
+
+def bidir_lingru_layer(layer: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """Both directions of one layer, [B,T,in] -> [B,T,2H] (fwd ++ bwd on
+    the feature axis, as ``gru.bidir_layer``).
+
+    One [B*T, in] x [in, 4H] matmul projects all four gates of both
+    directions; the backward direction's coefficients are time-reversed
+    so a SINGLE associative scan (directions stacked as a leading
+    batch dim) solves both recurrences at once."""
+    hidden = layer["fwd"]["w_zx"].shape[1]
+    w4 = jnp.concatenate(
+        [
+            layer["fwd"]["w_zx"], layer["fwd"]["w_cx"],
+            layer["bwd"]["w_zx"], layer["bwd"]["w_cx"],
+        ],
+        axis=1,
+    )
+    b4 = jnp.concatenate(
+        [
+            layer["fwd"]["b_z"], layer["fwd"]["b_c"],
+            layer["bwd"]["b_z"], layer["bwd"]["b_c"],
+        ]
+    )
+    proj = x @ w4 + b4  # [B,T,4H]
+    z_f = jax.nn.sigmoid(proj[..., :hidden])
+    c_f = jnp.tanh(proj[..., hidden : 2 * hidden])
+    z_b = jax.nn.sigmoid(proj[..., 2 * hidden : 3 * hidden])
+    c_b = jnp.tanh(proj[..., 3 * hidden :])
+    a = jnp.stack([1.0 - z_f, jnp.flip(1.0 - z_b, axis=1)])  # [2,B,T,H]
+    b = jnp.stack([z_f * c_f, jnp.flip(z_b * c_b, axis=1)])
+    h = linear_scan(a, b, axis=2)
+    return jnp.concatenate(
+        [h[0], jnp.flip(h[1], axis=1)], axis=-1
+    )  # [B,T,2H]
+
+
+def bidir_lingru_stack(
+    params: Tuple[Dict[str, Any], ...],
+    x: jax.Array,
+    *,
+    dropout: float = 0.0,
+    deterministic: bool = True,
+    rng: jax.Array | None = None,
+) -> jax.Array:
+    """Stacked bidirectional linear GRU, [B,T,in] -> [B,T,2H]. Dropout
+    between layers only, matching the GRU stack's (and torch's)
+    placement."""
+    num_layers = len(params)
+    for i, layer in enumerate(params):
+        x = bidir_lingru_layer(layer, x)
+        if dropout > 0.0 and not deterministic and i < num_layers - 1:
+            assert rng is not None
+            rng, sub = jax.random.split(rng)
+            x = _dropout(sub, x, dropout)
+    return x
+
+
+class RokoLinGRU:
+    """Functional container mirroring :class:`~roko_tpu.models.gru.RokoGRU`:
+    builds/holds no state, just init + apply."""
+
+    def __init__(self, in_size: int, hidden: int, num_layers: int, dropout: float):
+        self.in_size = in_size
+        self.hidden = hidden
+        self.num_layers = num_layers
+        self.dropout = dropout
+
+    def init(self, rng: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], ...]:
+        layers = []
+        for i in range(self.num_layers):
+            in_size = self.in_size if i == 0 else 2 * self.hidden
+            rng, kf, kb = jax.random.split(rng, 3)
+            layers.append(
+                {
+                    "fwd": lingru_layer_params(kf, in_size, self.hidden, dtype),
+                    "bwd": lingru_layer_params(kb, in_size, self.hidden, dtype),
+                }
+            )
+        return tuple(layers)
+
+    def apply(self, params, x, *, deterministic=True, rng=None):
+        return bidir_lingru_stack(
+            params,
+            x,
+            dropout=self.dropout,
+            deterministic=deterministic,
+            rng=rng,
+        )

@@ -14,6 +14,12 @@ bidir GRU x3 h=128 -> [B,90,256]
 head 256->5    -> logits [B,90,5]
 ```
 
+Three recurrence families share that skeleton behind ``ModelConfig.kind``:
+``"gru"`` (the torch-exact reference above), ``"lingru"`` (associative-
+scan gated linear recurrence, log-depth in T — models/lingru.py), and
+``"transformer"``. The front end and head are identical across kinds, so
+only the [B,90,500] -> [B,90,256] block differs.
+
 Implemented as a functional param-pytree model (no framework Module): the
 params dict is the single source of truth, which keeps torch-checkpoint
 conversion (`roko_tpu/models/convert.py`), Orbax serialisation and pjit
@@ -32,6 +38,7 @@ import jax.numpy as jnp
 from roko_tpu import constants as C
 from roko_tpu.config import ModelConfig
 from roko_tpu.models.gru import RokoGRU
+from roko_tpu.models.lingru import RokoLinGRU
 from roko_tpu.models.layers import (
     cast_tree,
     dense as _dense,
@@ -52,7 +59,7 @@ class RokoModel:
         transformer variant; None uses dense attention."""
         self.cfg = cfg or ModelConfig()
         self.attn_fn = attn_fn
-        if self.cfg.kind not in ("gru", "transformer"):
+        if self.cfg.kind not in ("gru", "lingru", "transformer"):
             raise ValueError(f"unknown model kind: {self.cfg.kind}")
         if self.cfg.kind == "transformer":
             # fail at construction, not first init/apply, if the variant
@@ -65,6 +72,13 @@ class RokoModel:
             self.cfg.dropout,
             use_pallas=self.cfg.use_pallas,
             remat_scan=self.cfg.remat_scan,
+        )
+        # stateless container — built unconditionally, like self.gru
+        self.lingru = RokoLinGRU(
+            self.cfg.gru_in_size,
+            self.cfg.hidden_size,
+            self.cfg.num_layers,
+            self.cfg.dropout,
         )
 
     # -- init ---------------------------------------------------------------
@@ -84,6 +98,8 @@ class RokoModel:
         }
         if cfg.kind == "gru":
             params["gru"] = self.gru.init(keys[4])
+        elif cfg.kind == "lingru":
+            params["lingru"] = self.lingru.init(keys[4])
         else:  # transformer params built in models/transformer.py
             from roko_tpu.models.transformer import transformer_init
 
@@ -171,6 +187,13 @@ class RokoModel:
         if cfg.kind == "gru":
             h = self.gru.apply(
                 cast_tree(params["gru"], dtype),
+                h,
+                deterministic=deterministic,
+                rng=rngs[3] if train else None,
+            )
+        elif cfg.kind == "lingru":
+            h = self.lingru.apply(
+                cast_tree(params["lingru"], dtype),
                 h,
                 deterministic=deterministic,
                 rng=rngs[3] if train else None,

@@ -47,8 +47,9 @@ def param_specs(cfg: ModelConfig, params: Dict[str, Any]) -> Dict[str, Any]:
         "fc2": {"kernel": P(), "bias": P()},
         "head": {"kernel": P(), "bias": P()},
     }
-    if cfg.kind == "gru":
-        specs["gru"] = _repl(params["gru"])
+    if cfg.kind in ("gru", "lingru"):
+        # the recurrent families replicate over tp (dp shards the batch)
+        specs[cfg.kind] = _repl(params[cfg.kind])
     else:
         n_layers = len(params["encoder"]["layers"])
         specs["encoder"] = {

@@ -27,6 +27,22 @@ def test_bench_json_contract(capsys, monkeypatch, tmp_path):
     assert detail["windows_per_sec"] >= detail["scan_windows_per_sec"]
     assert detail["model_flops_per_window"] > 0
     assert detail["torch_cpu_ref_windows_per_sec"] > 0
+    # per-kind rows (ISSUE 8): identical fixed work, model_kind recorded
+    kinds = detail["model_kinds"]
+    for kind in ("gru", "lingru"):
+        row = kinds[kind]
+        assert row["model_kind"] == kind
+        assert row["batch"] == 8
+        assert row["scan_windows_per_sec"] > 0
+    assert kinds["gru"]["iterations"] == kinds["lingru"]["iterations"]
+    assert detail["lingru_speedup_vs_gru"] > 0
+    # presence/shape only: the >1 speedup CLAIM belongs to the driver's
+    # artifact, not a contract test on a possibly-loaded CI box
+    assert detail["recurrence_only"]["lingru_speedup_vs_gru"] > 0
+    for kind in ("gru", "lingru"):
+        prec = detail["precision"][kind]
+        assert prec["f32_windows_per_sec"] > 0
+        assert prec["max_abs_logit_delta"] >= 0
     # the budget knob this test sets must hold on EVERY backend
     assert "train" not in detail
     import jax
@@ -82,12 +98,34 @@ def test_train_suite_budget_reports_skips():
     assert skipped and any("budget" in v["error"] for v in skipped)
 
 
+def _stub_kind_extras(monkeypatch):
+    """The per-kind/precision/recurrence rows drive the real model;
+    unit tests of the suite's wiring stub them to stay fast."""
+    monkeypatch.setattr(B, "bench_recurrence", lambda kind, b, iters: 50.0)
+    monkeypatch.setattr(
+        B,
+        "bench_precision",
+        lambda kind, b, iters, model_overrides=None: {
+            "model_kind": kind, "batch": b,
+            "f32_windows_per_sec": 1.0, "bf16_windows_per_sec": 2.0,
+            "max_abs_logit_delta": 0.01,
+        },
+    )
+
+
 def test_inference_suite_sweeps_batches_and_takes_best(monkeypatch):
     import jax
 
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     rates = {512: 100.0, 2048: 250.0}
-    monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1, detail=None: rates[b])
+    monkeypatch.setattr(
+        B,
+        "bench_infer",
+        lambda cfg, b, iters=1, detail=None: (
+            rates[b] * (4 if cfg.kind == "lingru" else 1)
+        ),
+    )
+    _stub_kind_extras(monkeypatch)
     detail = B.run_inference_suite()  # default run sweeps on TPU
     assert set(detail["batch_sweep"]) == {str(b) for b in B.SWEEP_BATCHES}
     # headline is best-of-sweep; the r2-comparable first batch stays
@@ -102,9 +140,164 @@ def test_inference_suite_sweeps_batches_and_takes_best(monkeypatch):
 
 def test_inference_suite_no_sweep_off_tpu(monkeypatch):
     monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=1, detail=None: 10.0)
+    _stub_kind_extras(monkeypatch)
     detail = B.run_inference_suite()
     assert set(detail["batch_sweep"]) == {str(B.BATCH)}
     assert "pallas_windows_per_sec" not in detail
+
+
+def test_inference_suite_reports_per_kind_rows(monkeypatch):
+    """ISSUE 8 acceptance wiring: both kinds reported on IDENTICAL
+    fixed work (same batch + iteration count), each row carrying its
+    model_kind, plus the speedup ratio, the recurrence-isolated A/B,
+    and the f32-vs-bf16 precision column."""
+    rates = {"gru": 100.0, "lingru": 600.0}
+    monkeypatch.setattr(
+        B,
+        "bench_infer",
+        lambda cfg, b, iters=1, detail=None: rates[cfg.kind],
+    )
+    monkeypatch.setattr(
+        B, "bench_recurrence",
+        lambda kind, b, iters: 1000.0 if kind == "lingru" else 125.0,
+    )
+    monkeypatch.setattr(
+        B,
+        "bench_precision",
+        lambda kind, b, iters, model_overrides=None: {
+            "model_kind": kind, "batch": b,
+            "f32_windows_per_sec": 1.0, "bf16_windows_per_sec": 2.0,
+            "max_abs_logit_delta": 0.01,
+        },
+    )
+    detail = B.run_inference_suite(64, iters=7)
+    kinds = detail["model_kinds"]
+    assert set(kinds) == {"gru", "lingru"}
+    for kind, row in kinds.items():
+        assert row["model_kind"] == kind
+        assert row["batch"] == 64 and row["iterations"] == 7
+        assert row["scan_windows_per_sec"] == rates[kind]
+    assert detail["lingru_speedup_vs_gru"] == 6.0
+    assert detail["recurrence_only"]["lingru_speedup_vs_gru"] == 8.0
+    assert set(detail["precision"]) == {"gru", "lingru"}
+    assert detail["precision"]["gru"]["max_abs_logit_delta"] == 0.01
+
+
+def test_inference_suite_lingru_failure_is_reported_not_fatal(monkeypatch):
+    """A lingru-row failure lands in the row as an error — the gru
+    headline (the driver metric) must survive it."""
+
+    def infer(cfg, b, iters=1, detail=None):
+        if cfg.kind == "lingru":
+            raise RuntimeError("lingru exploded")
+        return 100.0
+
+    monkeypatch.setattr(B, "bench_infer", infer)
+    _stub_kind_extras(monkeypatch)
+    detail = B.run_inference_suite(64, iters=2)
+    assert detail["windows_per_sec"] == 100.0
+    assert "lingru exploded" in detail["model_kinds"]["lingru"]["error"]
+    assert "lingru_speedup_vs_gru" not in detail
+
+
+def test_bench_precision_reports_dtype_ab():
+    """The real precision column on a tiny model: both dtype rates and
+    a finite logit delta (bf16 matmuls genuinely differ from f32)."""
+    row = B.bench_precision(
+        "lingru", 4, 2,
+        model_overrides=dict(
+            embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+        ),
+    )
+    assert row["f32_windows_per_sec"] > 0
+    assert row["bf16_windows_per_sec"] > 0
+    assert 0 < row["max_abs_logit_delta"] < 1.0
+
+
+def test_model_flops_lingru_below_gru():
+    gru = B.model_flops_per_window(ModelConfig())
+    lin = B.model_flops_per_window(ModelConfig(kind="lingru"))
+    assert 0 < lin < gru  # no hidden matmul, 2 gates instead of 3
+
+
+def test_compare_to_previous_flags_noise_and_regression():
+    """Bench hygiene (ROADMAP watch item 6): single-digit-% deltas are
+    noise=true, only moves beyond the band are regressions — for the
+    headline, vs_baseline, AND the per-kind rows."""
+    cur = {
+        "value": 2820.0,
+        "vs_baseline": 0.95,
+        "detail": {
+            "iterations": 20,
+            "windows_per_sec": 94.0,
+            "scan_windows_per_sec": 94.0,
+            "model_kinds": {
+                "gru": {"scan_windows_per_sec": 94.0},
+                "lingru": {"scan_windows_per_sec": 400.0},
+            },
+        },
+    }
+    prev = {
+        "value": 3525.0,
+        "vs_baseline": 1.0,
+        "detail": {
+            "iterations": 20,
+            "windows_per_sec": 100.0,
+            "scan_windows_per_sec": 100.0,
+            "model_kinds": {"gru": {"scan_windows_per_sec": 500.0}},
+        },
+    }
+    block = B.compare_to_previous(cur, prev)
+    m = block["metrics"]
+    # -6%: inside the band -> noise, never a regression
+    assert m["windows_per_sec"]["noise"] is True
+    assert "regression" not in m["windows_per_sec"]
+    assert m["vs_baseline"]["noise"] is True
+    # -20% / -81.2%: beyond the band -> regression, not noise
+    assert m["value"]["regression"] is True and not m["value"]["noise"]
+    gk = m["model_kinds.gru.scan_windows_per_sec"]
+    assert gk["regression"] is True
+    # lingru had no previous row: absent, not a crash
+    assert "model_kinds.lingru.scan_windows_per_sec" not in m
+    assert cur["detail"]["vs_previous"] is block
+    assert block["iterations"] == 20 and block["previous_iterations"] == 20
+
+
+def test_apply_compare_survives_unreadable_previous(tmp_path):
+    result = {"value": 1.0, "detail": {}}
+    B._apply_compare(result, str(tmp_path / "missing.json"))
+    assert "error" in result["detail"]["vs_previous"]
+
+
+def test_bench_compare_defaults_to_fixed_work(capsys, monkeypatch, tmp_path):
+    """--compare pins the iteration count (fixed-work mode) and lands a
+    vs_previous block in the emitted artifact."""
+    prev_path = tmp_path / "prev.json"
+    prev_path.write_text(json.dumps({
+        "value": 100.0, "vs_baseline": 1.0,
+        "detail": {"windows_per_sec": 10.0, "iterations": B.ITERS},
+    }))
+    seen = {}
+
+    def fake_measure(args):
+        seen["iters"] = args.bench_iterations
+        return {
+            "metric": "polished_bases_per_sec_per_chip", "value": 300.0,
+            "unit": "bases/s", "vs_baseline": 1.0,
+            "detail": {"windows_per_sec": 10.5, "iterations": args.bench_iterations},
+        }
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setattr(B, "_measure", fake_measure)
+    B.main(["--compare", str(prev_path)])
+    assert seen["iters"] == B.ITERS  # fixed-work default engaged
+    result = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    vs = result["detail"]["vs_previous"]
+    assert vs["file"] == str(prev_path)
+    assert vs["metrics"]["windows_per_sec"]["noise"] is True  # +5%
+    # a 3x IMPROVEMENT is outside the band but never a "regression"
+    assert vs["metrics"]["value"]["noise"] is False
+    assert "regression" not in vs["metrics"]["value"]
 
 
 def test_e2e_suite_reports_pipeline_breakdown():
@@ -295,6 +488,7 @@ def test_measure_flushes_partials_incrementally(monkeypatch, tmp_path):
     import pytest
 
     monkeypatch.setattr(B, "bench_infer", lambda cfg, b, iters=None, detail=None: 10.0)
+    _stub_kind_extras(monkeypatch)
 
     def boom():
         raise RuntimeError("torch ref exploded")

@@ -1,0 +1,500 @@
+"""``kind="lingru"`` — the associative-scan linear-GRU variant (ISSUE 8).
+
+Three contracts pinned here:
+
+1. **Numerical equivalence**: the ``lax.associative_scan`` evaluation of
+   ``h_t = (1 - z_t) * h_{t-1} + z_t * c_t`` matches a naive per-step
+   evaluation of the same recurrence to <= 1e-5 in float32 — forward AND
+   gradients, both directions, multi-layer.
+2. **GRU regression freedom**: ``kind="gru"`` outputs stay byte-identical
+   to a golden artifact generated at the pre-PR HEAD
+   (tests/data/gru_golden_prepr8.npz) — the lingru lands beside the
+   torch-exact reference, never inside it.
+3. **Kind plumbing**: config validation, CLI flags, the training loop,
+   the serve session ladder, and the AOT bundle digest (a gru bundle
+   must refuse to load into a lingru session with a field-by-field
+   ``BundleMismatch`` diff naming ``model.kind``).
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    CompileConfig,
+    MeshConfig,
+    ModelConfig,
+    RokoConfig,
+    ServeConfig,
+    TrainConfig,
+)
+from roko_tpu.models import RokoModel
+from roko_tpu.models.lingru import (
+    RokoLinGRU,
+    bidir_lingru_layer,
+    bidir_lingru_stack,
+    linear_scan,
+    linear_scan_ref,
+    lingru_direction,
+)
+
+TINY_LIN = ModelConfig(
+    kind="lingru", embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=2
+)
+TINY_GRU = dataclasses.replace(TINY_LIN, kind="gru")
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "data", "gru_golden_prepr8.npz")
+
+
+# -- numerical equivalence: associative scan == naive per-step ----------------
+
+
+def test_linear_scan_matches_naive_per_step(rng):
+    a = jnp.asarray(rng.uniform(0.0, 1.0, (4, 33, 7)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((4, 33, 7)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(linear_scan(a, b, axis=1)),
+        np.asarray(linear_scan_ref(a, b)),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("reverse", [False, True], ids=["fwd", "bwd"])
+def test_direction_matches_naive_reference(rng, reverse):
+    layer = RokoLinGRU(12, 16, 1, 0.0).init(jax.random.PRNGKey(3))[0]
+    x = jnp.asarray(rng.standard_normal((5, 90, 12)), jnp.float32)
+    got = lingru_direction(layer["fwd"], x, reverse=reverse)
+    want = lingru_direction(layer["fwd"], x, reverse=reverse, naive=True)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def _naive_stack(params, x):
+    """Per-step reference of the full bidirectional multi-layer stack."""
+    for layer in params:
+        x = jnp.concatenate(
+            [
+                lingru_direction(layer["fwd"], x, naive=True),
+                lingru_direction(layer["bwd"], x, reverse=True, naive=True),
+            ],
+            axis=-1,
+        )
+    return x
+
+
+def test_bidir_layer_matches_per_direction(rng):
+    """The fused single-scan bidirectional layer == two per-direction
+    passes (fwd ++ time-reversed bwd), as the GRU's bidir_layer test."""
+    layer = RokoLinGRU(24, 16, 1, 0.0).init(jax.random.PRNGKey(11))[0]
+    x = jnp.asarray(rng.standard_normal((5, 90, 24)), jnp.float32)
+    want = jnp.concatenate(
+        [
+            lingru_direction(layer["fwd"], x),
+            lingru_direction(layer["bwd"], x, reverse=True),
+        ],
+        axis=-1,
+    )
+    got = bidir_lingru_layer(layer, x)
+    np.testing.assert_allclose(
+        np.asarray(want), np.asarray(got), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_multilayer_stack_matches_naive_reference(rng):
+    params = RokoLinGRU(12, 16, 3, 0.0).init(jax.random.PRNGKey(5))
+    x = jnp.asarray(rng.standard_normal((4, 60, 12)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(bidir_lingru_stack(params, x)),
+        np.asarray(_naive_stack(params, x)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_grads_match_naive_reference(rng):
+    """Backward parity: gradients through the associative scan equal
+    gradients through the per-step reference (every param leaf AND the
+    input), multi-layer + both directions."""
+    params = RokoLinGRU(10, 12, 2, 0.0).init(jax.random.PRNGKey(7))
+    x = jnp.asarray(rng.standard_normal((3, 40, 10)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 40, 24)), jnp.float32)  # [B,T,2H]
+
+    def loss(fn, p, x):
+        return (fn(p, x) * w).mean()
+
+    v0, g0 = jax.value_and_grad(
+        lambda p: loss(lambda p, x: bidir_lingru_stack(p, x), p, x)
+    )(params)
+    v1, g1 = jax.value_and_grad(lambda p: loss(_naive_stack, p, x))(params)
+    assert np.allclose(v0, v1, rtol=1e-6, atol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+        g0,
+        g1,
+    )
+    gx0 = jax.grad(lambda x: loss(lambda p, x: bidir_lingru_stack(p, x), params, x))(x)
+    gx1 = jax.grad(lambda x: loss(_naive_stack, params, x))(x)
+    np.testing.assert_allclose(
+        np.asarray(gx0), np.asarray(gx1), rtol=1e-5, atol=1e-6
+    )
+
+
+# -- model integration --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def lin_model():
+    return RokoModel(TINY_LIN)
+
+
+@pytest.fixture(scope="module")
+def lin_params(lin_model):
+    return lin_model.init(jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(7)
+    return jnp.asarray(
+        rng.integers(0, C.FEATURE_VOCAB, (4, C.WINDOW_ROWS, C.WINDOW_COLS)),
+        dtype=jnp.int32,
+    )
+
+
+def test_lingru_model_forward_shape_and_determinism(lin_model, lin_params, batch):
+    logits = lin_model.apply(lin_params, batch)
+    assert logits.shape == (4, C.WINDOW_COLS, C.NUM_CLASSES)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    np.testing.assert_array_equal(
+        np.asarray(logits), np.asarray(lin_model.apply(lin_params, batch))
+    )
+    assert "lingru" in lin_params and "gru" not in lin_params
+
+
+def test_lingru_model_dropout_and_grads(lin_model, lin_params, batch):
+    a = lin_model.apply(
+        lin_params, batch, deterministic=False, rng=jax.random.key(1)
+    )
+    b = lin_model.apply(
+        lin_params, batch, deterministic=False, rng=jax.random.key(2)
+    )
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    c = lin_model.apply(
+        lin_params, batch, deterministic=False, rng=jax.random.key(1)
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    grads = jax.grad(
+        lambda p: (
+            lin_model.apply(
+                p, batch, deterministic=False, rng=jax.random.key(1)
+            ).astype(jnp.float32)
+            ** 2
+        ).mean()
+    )(lin_params)
+    assert all(
+        bool(jnp.isfinite(g).all()) for g in jax.tree.leaves(grads)
+    )
+
+
+# -- gru regression guard -----------------------------------------------------
+
+
+def test_gru_outputs_byte_identical_to_pre_pr_golden():
+    """The lingru lands BESIDE the reference recurrence: kind="gru"
+    logits must stay byte-for-byte what the pre-PR tree produced for
+    the same checkpoint and input (golden generated at HEAD 23729f5).
+    The artifact carries the PARAMS, not just the seed — the forward is
+    deterministic-RNG-free, so the guard is immune to global PRNG
+    config (jax_threefry_partitionable) other tests may flip."""
+    gold = np.load(GOLDEN)
+    model = RokoModel(TINY_GRU)
+    template = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    treedef = jax.tree_util.tree_structure(template)
+    n = treedef.num_leaves
+    params = jax.tree_util.tree_unflatten(
+        treedef, [gold[f"param_{i:03d}"] for i in range(n)]
+    )
+    logits = np.asarray(model.apply(params, gold["x"], deterministic=True))
+    assert logits.dtype == np.float32
+    np.testing.assert_array_equal(logits, gold["logits"])
+
+
+# -- kind plumbing: config + CLI ----------------------------------------------
+
+
+def test_config_rejects_unknown_kind():
+    with pytest.raises(ValueError, match="unknown model kind"):
+        ModelConfig(kind="bogus")
+    with pytest.raises(ValueError, match="unknown model kind"):
+        RokoConfig.from_json('{"model": {"kind": "grru"}}')
+
+
+def test_config_json_roundtrip_preserves_kind():
+    cfg = RokoConfig(model=ModelConfig(kind="lingru"))
+    assert RokoConfig.from_json(cfg.to_json()).model.kind == "lingru"
+
+
+@pytest.mark.parametrize(
+    "argv",
+    [
+        ["train", "d.hdf5", "out", "--model-kind", "lingru"],
+        ["inference", "d.hdf5", "ckpt", "out.fa", "--model-kind", "lingru"],
+        ["polish", "r.fa", "x.bam", "ckpt", "o.fa", "--model-kind", "lingru"],
+        ["compile", "bundle", "--model-kind", "lingru"],
+        ["serve", "ckpt", "--model-kind", "lingru"],
+    ],
+    ids=["train", "inference", "polish", "compile", "serve"],
+)
+def test_cli_accepts_model_kind_lingru(argv):
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args(argv)
+    assert _build_config(args).model.kind == "lingru"
+
+
+def test_param_sharding_handles_lingru():
+    """The tp sharding helper must treat lingru like gru (replicated
+    params, dp shards the batch), not fall into the transformer branch
+    (KeyError: 'encoder')."""
+    from roko_tpu.parallel.mesh import make_mesh
+    from roko_tpu.parallel.tp import param_specs, param_sharding
+
+    params = RokoModel(TINY_LIN).init(jax.random.PRNGKey(0))
+    specs = param_specs(TINY_LIN, params)
+    assert "lingru" in specs and "encoder" not in specs
+    shardings = param_sharding(TINY_LIN, params, make_mesh(MeshConfig(dp=8)))
+    assert jax.tree_util.tree_structure(shardings) == jax.tree_util.tree_structure(
+        jax.tree.map(lambda a: 0, params)
+    )
+
+
+# -- training path ------------------------------------------------------------
+
+
+def test_lingru_trains_with_existing_recipe(rng, tmp_path):
+    """The unchanged train loop (guard + checkpoints included) accepts
+    kind=lingru: loss decreases and the checkpoint restores the lingru
+    param tree."""
+    from tests.test_training import _window_batch, _write_train_hdf5
+
+    X, Y = _window_batch(rng, 96)
+    _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
+    cfg = RokoConfig(
+        model=TINY_LIN,
+        train=TrainConfig(batch_size=16, epochs=3, lr=1e-2, in_memory=True),
+        mesh=MeshConfig(dp=8),
+    )
+    logs = []
+    state = train_loop(cfg, tmp_path, logs)
+    assert int(jax.device_get(state.step)) == 3 * 6
+    import re
+
+    losses = [
+        float(m.group(1))
+        for m in (re.search(r"train_loss ([0-9.]+)", l) for l in logs)
+        if m
+    ]
+    assert losses[-1] < losses[0]
+
+    from roko_tpu.training.checkpoint import load_params
+
+    params = load_params(str(tmp_path / "ckpt"))
+    assert "lingru" in params and "gru" not in params
+    assert len(params["lingru"]) == TINY_LIN.num_layers
+
+
+def train_loop(cfg, tmp_path, logs):
+    from roko_tpu.training.loop import train
+
+    return train(
+        cfg,
+        str(tmp_path / "train.hdf5"),
+        str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+
+
+# -- serve session + AOT bundles ----------------------------------------------
+
+SERVE_LIN = RokoConfig(
+    model=TINY_LIN, mesh=MeshConfig(dp=8), serve=ServeConfig(ladder=(8, 16))
+)
+SERVE_GRU = dataclasses.replace(SERVE_LIN, model=TINY_GRU)
+
+
+def test_polish_session_lingru_ladder_zero_recompiles():
+    from roko_tpu.serve import PolishSession
+
+    params = RokoModel(TINY_LIN).init(jax.random.PRNGKey(0))
+    session = PolishSession(params, SERVE_LIN)
+    session.warmup()
+    compiled = session.cache_size()
+    rng = np.random.default_rng(0)
+    for n in (3, 9, 16):
+        preds = session.predict(
+            rng.integers(0, C.FEATURE_VOCAB, (n, 200, 90)).astype(np.uint8)
+        )
+        assert preds.shape == (n, C.WINDOW_COLS)
+    assert session.cache_size() == compiled
+    assert session.dispatched_shapes <= set(session.ladder)
+
+
+@pytest.fixture(scope="module")
+def lin_bundle(tmp_path_factory):
+    from roko_tpu.compile import export_bundle
+
+    out = str(tmp_path_factory.mktemp("lin-bundle") / "aot")
+    export_bundle(out, SERVE_LIN, ladder=(8,), log=lambda m: None)
+    return out
+
+
+def test_lingru_bundle_roundtrip_byte_identical(lin_bundle, rng):
+    """`roko-tpu compile` works per kind: a lingru bundle loads into a
+    lingru session with zero jit compiles and byte-identical output."""
+    from roko_tpu.serve import PolishSession
+
+    params = RokoModel(TINY_LIN).init(jax.random.PRNGKey(0))
+    jit_session = PolishSession(params, SERVE_LIN, ladder=(8,))
+    jit_session.warmup()
+    aot_cfg = dataclasses.replace(
+        SERVE_LIN, compile=CompileConfig(bundle_dir=lin_bundle)
+    )
+    aot_session = PolishSession(params, aot_cfg, ladder=(8,))
+    aot_session.warmup(log=None)
+    assert aot_session.warmup_report.mode == "aot"
+    assert aot_session.cache_size() == 0
+    x = rng.integers(0, C.FEATURE_VOCAB, (5, 200, 90)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        aot_session.predict(x), jit_session.predict(x)
+    )
+
+
+def test_bundle_digest_covers_kind(tmp_path):
+    """ISSUE acceptance: loading a gru bundle into a lingru session
+    refuses with a field-by-field diff naming model.kind — wrong
+    results are impossible, not just unlikely."""
+    from roko_tpu.compile import BundleMismatch, export_bundle, load_bundle
+
+    bundle = str(tmp_path / "gru-aot")
+    export_bundle(bundle, SERVE_GRU, ladder=(8,), log=lambda m: None)
+    with pytest.raises(BundleMismatch, match=r"model\.kind"):
+        load_bundle(bundle, SERVE_LIN, log=lambda m: None)
+    # and the diff names both sides
+    with pytest.raises(BundleMismatch, match="lingru"):
+        load_bundle(bundle, SERVE_LIN, log=lambda m: None)
+
+
+def test_cache_probe_prints_bundle_kind(lin_bundle):
+    """Operators must be able to tell which model kind a cached bundle
+    digest belongs to (ISSUE satellite): the one-line inventory names
+    it."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "tools/cache_probe.py", "--bundle", lin_bundle],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=120,
+    )
+    assert r.returncode == 0
+    assert "kind=lingru" in r.stdout
+    assert "digest=" in r.stdout
+
+
+def test_cli_compile_prints_kind(tmp_path, capsys):
+    from roko_tpu.cli import main
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(SERVE_LIN.to_json())
+    rc = main(
+        [
+            "compile", str(tmp_path / "bundle"), "--config", str(cfg_path),
+            "--ladder", "8", "--no-verify",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "kind lingru" in out and "digest" in out
+
+
+# -- slow lane: train -> inference -> assess accuracy gate --------------------
+
+
+@pytest.mark.slow
+def test_lingru_q_within_half_of_gru_reference(tmp_path):
+    """The accuracy gate behind the speed claim: trained with the
+    EXISTING protocol on the same homopolymer-regime sim data, the
+    lingru's held-out Q must land within 0.5 of the GRU reference
+    (and both must genuinely polish). This is the tiny-draft
+    train->inference->assess smoke the CI slow lane runs."""
+    from roko_tpu.eval.assess import assess_pair
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.infer import run_inference
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+    from roko_tpu.training.loop import train
+    from tests.helpers import make_record
+    from tests.test_end_to_end import _build_genome
+
+    truth_a, draft_a, cig_a, reads_a = _build_genome(1, 9000, "train", hp=True)
+    write_fasta(str(tmp_path / "a.fasta"), [("train", draft_a)])
+    write_sorted_bam(str(tmp_path / "a.bam"), [("train", len(draft_a))], reads_a)
+    truth_rec = make_record("truth", 0, 0, truth_a, cig_a)
+    write_sorted_bam(
+        str(tmp_path / "a_truth.bam"), [("train", len(draft_a))], [truth_rec]
+    )
+    run_features(
+        str(tmp_path / "a.fasta"), str(tmp_path / "a.bam"),
+        str(tmp_path / "train.hdf5"), bam_y=str(tmp_path / "a_truth.bam"),
+        seed=3,
+    )
+    truth_b, draft_b, _, reads_b = _build_genome(2, 6000, "eval", hp=True)
+    write_fasta(str(tmp_path / "b.fasta"), [("eval", draft_b)])
+    write_sorted_bam(str(tmp_path / "b.bam"), [("eval", len(draft_b))], reads_b)
+    run_features(
+        str(tmp_path / "b.fasta"), str(tmp_path / "b.bam"),
+        str(tmp_path / "infer.hdf5"), seed=4,
+    )
+
+    qs = {}
+    for kind in ("gru", "lingru"):
+        cfg = RokoConfig(
+            model=ModelConfig(
+                kind=kind, embed_dim=32, read_mlp=(64, 8),
+                hidden_size=64, num_layers=2,
+            ),
+            train=TrainConfig(batch_size=64, epochs=10, lr=1.5e-3, patience=10),
+            mesh=MeshConfig(dp=8),
+        )
+        state = train(
+            cfg, str(tmp_path / "train.hdf5"), str(tmp_path / f"ckpt-{kind}"),
+            log=lambda s: None,
+        )
+        polished = run_inference(
+            str(tmp_path / "infer.hdf5"),
+            jax.device_get(state.params),
+            cfg,
+            batch_size=64,
+            log=lambda s: None,
+        )["eval"]
+        res = assess_pair(
+            truth_b.encode(), polished.encode(), truth_name="eval"
+        )
+        draft_res = assess_pair(
+            truth_b.encode(), draft_b.encode(), truth_name="eval"
+        )
+        assert res.error_rate < draft_res.error_rate, (kind, res, draft_res)
+        # cap: a perfect polish has infinite Q; compare on a bounded scale
+        qs[kind] = min(res.qscore, 60.0)
+    assert qs["lingru"] >= qs["gru"] - 0.5, qs

@@ -53,8 +53,13 @@ def main() -> int:
             print(f"bundle: {bundle} INVALID — {e}")
             continue
         ident = man.get("identity", {})
+        # kind rides in the digested ModelConfig: operators can tell at a
+        # glance which model family a cached bundle belongs to (a
+        # mismatched kind refuses to load — docs/SERVING.md)
+        kind = (ident.get("model") or {}).get("kind", "?")
         print(
-            f"bundle: {bundle} digest={man.get('digest', '?')[:12]} "
+            f"bundle: {bundle} kind={kind} "
+            f"digest={man.get('digest', '?')[:12]} "
             f"rungs={man.get('rungs')} backend={ident.get('backend')}/"
             f"{ident.get('device_kind')} jax={ident.get('jax_version')}"
         )
