@@ -551,7 +551,255 @@ def run_train_suite(
         # skipped, not silently omit it (r5 review)
         if progress is not None:
             progress(out)
+    # input_stall_fraction: how much of the step the device waits on
+    # host data through the real sharded input pipeline (ROADMAP item 5)
+    if budget_s is not None and time.perf_counter() - t0 > budget_s:
+        out["input_stall"] = {
+            "error": f"skipped: {budget_s:.0f}s bench budget spent"
+        }
+    else:
+        try:
+            stall = bench_input_stall(
+                ModelConfig(compute_dtype="bfloat16"), batch, iters
+            )
+            out["input_stall"] = stall
+            out["input_stall_fraction"] = stall["stall_fraction"]
+        except Exception as e:
+            out["input_stall"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    if progress is not None:
+        progress(out)
     return out
+
+
+def _write_bench_corpus(out_dir: str, rows: int, files: int) -> None:
+    """A multi-file sim training corpus with the real window geometry
+    (the input suite must measure real 200x90 uint8 row traffic)."""
+    from roko_tpu import constants as C
+    from roko_tpu.data.hdf5 import DataWriter
+
+    rng = np.random.default_rng(0)
+    per = -(-rows // files)
+    done = 0
+    for fi in range(files):
+        n = min(per, rows - done)
+        if n <= 0:
+            break
+        done += n
+        X = rng.integers(
+            0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)
+        ).astype(np.uint8)
+        Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+        pos = [
+            np.stack([np.arange(C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1)
+        ] * n
+        with DataWriter(os.path.join(out_dir, f"part{fi}.hdf5"), infer=False) as w:
+            w.write_contigs([(f"c{fi}", "ACGT" * 50)])
+            w.store(f"c{fi}", pos, list(X), list(Y))
+
+
+def run_input_suite(
+    rows: int = 1536, files: int = 3, batch: int = 128
+) -> Dict[str, Any]:
+    """Input data plane: samples/sec off the datapipe index layer vs the
+    legacy shuffle-buffer streaming reader on the same sim corpus
+    (ROADMAP item 5), plus the O(spans skipped) fast-forward vs the
+    legacy prefix re-read, the bounded-memory evidence
+    (max_resident_rows), and a 2-shard partition sanity check. Host-only
+    numbers — meaningful on any box; ``rows`` is the fixed work."""
+    import tempfile
+
+    from roko_tpu.datapipe import ReadStats, ShardedDataset
+    from roko_tpu.training.lazy_data import StreamingDataset
+
+    # block/mix sized to the bench corpus so skip granularity and
+    # residency are visible against `rows` (the real defaults assume a
+    # corpus of millions of windows)
+    block = max(32, rows // 12)
+    mix = 2
+    out: Dict[str, Any] = {
+        "rows": rows, "files": files, "batch": batch,
+        "block_size": block, "mix_blocks": mix,
+    }
+
+    def _drain(it) -> int:
+        n = 0
+        for _x, _y, w in it:
+            n += int(w.sum())
+        return n
+
+    with tempfile.TemporaryDirectory() as td:
+        _write_bench_corpus(td, rows, files)
+
+        legacy = StreamingDataset(td, chunk_size=block, buffer_chunks=16)
+        t0 = time.perf_counter()
+        n = _drain(
+            legacy.legacy_batches(
+                batch, rng=np.random.default_rng(0), pad_to=batch
+            )
+        )
+        dt_legacy = time.perf_counter() - t0
+        out["legacy_stream"] = {
+            "rows_per_sec": round(n / dt_legacy, 1),
+            "seconds": round(dt_legacy, 3),
+        }
+
+        ds = ShardedDataset(
+            td, seed=0, block_size=block, mix_blocks=mix, prefetch_blocks=2
+        )
+        stats = ReadStats()
+        t0 = time.perf_counter()
+        n = _drain(
+            ds.batches(batch, rng=ds.epoch_rng(0), pad_to=batch, stats=stats)
+        )
+        dt_pipe = time.perf_counter() - t0
+        out["datapipe_stream"] = {
+            "rows_per_sec": round(n / dt_pipe, 1),
+            "seconds": round(dt_pipe, 3),
+            "rows_read": stats.rows_read,
+            "max_resident_rows": stats.max_resident_rows,
+        }
+        out["speedup_vs_legacy"] = round(dt_legacy / max(dt_pipe, 1e-9), 2)
+
+        pre = ShardedDataset(
+            td, seed=0, block_size=block, mix_blocks=mix, preload=True
+        )
+        t0 = time.perf_counter()
+        n = _drain(pre.batches(batch, rng=pre.epoch_rng(0), pad_to=batch))
+        out["preload_rows_per_sec"] = round(n / (time.perf_counter() - t0), 1)
+
+        # resume fast-forward: skip half the epoch. The index layer
+        # must only read what remains; the legacy reader re-reads (and
+        # re-shuffles) the whole prefix.
+        skip = (rows // batch) // 2
+        ff_stats = ReadStats()
+        t0 = time.perf_counter()
+        _drain(
+            ds.batches(
+                batch, rng=ds.epoch_rng(0), pad_to=batch,
+                skip_batches=skip, stats=ff_stats,
+            )
+        )
+        dt_ff = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        _drain(
+            legacy.legacy_batches(
+                batch, rng=np.random.default_rng(0), pad_to=batch,
+                skip_batches=skip,
+            )
+        )
+        dt_ff_legacy = time.perf_counter() - t0
+        out["fast_forward"] = {
+            "skip_batches": skip,
+            "datapipe_rows_read": ff_stats.rows_read,
+            "datapipe_seconds": round(dt_ff, 3),
+            "legacy_seconds": round(dt_ff_legacy, 3),
+        }
+
+        # shard partition sanity on the same corpus: 2 shard streams
+        # must cover exactly the corpus, disjointly
+        n01 = sum(
+            _drain(
+                ShardedDataset(
+                    td, seed=0, block_size=block, mix_blocks=mix,
+                    num_shards=2, shard_id=s,
+                ).batches(
+                    batch, rng=ds.epoch_rng(0), pad_to=batch, equalize=False
+                )
+            )
+            for s in (0, 1)
+        )
+        out["shard2_union_rows"] = n01
+        out["shard2_union_ok"] = bool(n01 == rows)
+    return out
+
+
+def bench_input_stall(cfg, batch: int, iters: int) -> Dict[str, Any]:
+    """input_stall_fraction: the fraction of train-step wall time the
+    device spends waiting on host data — the same fused train step
+    timed (a) fed by the real sharded input pipeline (manifest index,
+    span reads, host prefetch, device placement) and (b) on one
+    device-resident batch. ``1 - static/piped``, floored at 0."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from roko_tpu.config import MeshConfig
+    from roko_tpu.datapipe import ShardedDataset
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.parallel.mesh import make_mesh
+    from roko_tpu.training.data import prefetch_to_device
+    from roko_tpu.training.loop import create_state, make_placer, make_train_step
+
+    mesh = make_mesh(MeshConfig(dp=-1))
+    model = RokoModel(cfg)
+    tx = optax.adam(1e-4)
+    state = create_state(model, tx, jax.random.PRNGKey(0))
+    step = make_train_step(model, tx, mesh)
+    rng_key = jax.random.PRNGKey(1)
+    step_no = jnp.zeros((), jnp.int32)
+
+    # size the corpus so warmup + the timed window fit in ONE epoch —
+    # an epoch restart mid-measurement (fresh schedule, cold fds, new
+    # prefetch thread) would make the stall number track restart cost,
+    # not steady-state input stall. The row cap bounds corpus-write
+    # time; iters shrinks to fit and the effective count is recorded.
+    rows = min((WARMUP + iters) * batch, 6144)
+    iters = max(2, min(iters, rows // batch - WARMUP))
+
+    with tempfile.TemporaryDirectory() as td:
+        _write_bench_corpus(td, rows, 2)
+        ds = ShardedDataset(td, seed=0, block_size=256, prefetch_blocks=2)
+        place = make_placer(mesh)
+
+        def piped(n_steps):
+            done, epoch = 0, 0
+            while done < n_steps:
+                it = ds.batches(
+                    batch, rng=ds.epoch_rng(epoch), pad_to=batch,
+                    drop_remainder=True,
+                )
+                for b in prefetch_to_device(it, 2, place):
+                    yield b
+                    done += 1
+                    if done >= n_steps:
+                        return
+                epoch += 1
+
+        params, opt_state = state.params, state.opt_state
+        static = None
+        for x, y, w in piped(WARMUP):  # warmup: compile + first reads
+            params, opt_state, loss, _ = step(
+                params, opt_state, step_no, x, y, w, rng_key
+            )
+            static = (x, y, w)
+        np.asarray(loss)
+
+        x, y, w = static
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            params, opt_state, loss, _ = step(
+                params, opt_state, step_no, x, y, w, rng_key
+            )
+        np.asarray(loss)
+        dt_static = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for x, y, w in piped(iters):
+            params, opt_state, loss, _ = step(
+                params, opt_state, step_no, x, y, w, rng_key
+            )
+        np.asarray(loss)
+        dt_piped = time.perf_counter() - t0
+
+    return {
+        "stall_fraction": round(max(0.0, 1.0 - dt_static / max(dt_piped, 1e-9)), 4),
+        "static_step_ms": round(1e3 * dt_static / iters, 2),
+        "piped_step_ms": round(1e3 * dt_piped / iters, 2),
+        "iterations": iters,
+        "batch": batch,
+    }
 
 
 def run_features_suite(
@@ -780,6 +1028,19 @@ def _measure(args) -> Dict[str, Any]:
         except Exception as e:  # report, never swallow
             detail["coldstart"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("coldstart", detail["coldstart"])
+    input_rows = getattr(args, "input_rows", None)
+    if input_rows is None:
+        # default follows the e2e scale decision (as coldstart): the
+        # cheap contract-mode runs skip it, the driver's plain run
+        # measures it. Host-only fixed work — backend-independent.
+        input_rows = 1536 if e2e_draft else 0
+    if input_rows:
+        _stamp(f"input suite (datapipe vs legacy reader, {input_rows} rows)")
+        try:
+            detail["input"] = run_input_suite(input_rows)
+        except Exception as e:  # report, never swallow
+            detail["input"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("input", detail["input"])
     fleet_workers = getattr(args, "fleet_workers", None)
     if fleet_workers is None:
         # default follows the e2e scale decision (as coldstart):
@@ -1738,6 +1999,14 @@ def main(argv=None) -> None:
         "inference/train suites and the per-client request count of "
         "the fleet suite (recorded in the artifact; ROADMAP watch "
         "item 6)",
+    )
+    ap.add_argument(
+        "--input-rows",
+        type=int,
+        default=None,
+        help="input suite fixed work: sim-corpus rows streamed through "
+        "the datapipe index layer vs the legacy streaming reader "
+        "(default 1536 when the e2e suite runs; 0 disables)",
     )
     ap.add_argument(
         "--compare",

@@ -114,6 +114,46 @@ def _resilience_args(p: argparse.ArgumentParser, serve: bool = False) -> None:
         )
 
 
+def _data_args(p: argparse.ArgumentParser) -> None:
+    """Sharded input data plane knobs (DataConfig, docs/TRAINING.md
+    "Sharded input pipeline")."""
+    p.add_argument(
+        "--data-shards", type=int, default=None,
+        help="split the training corpus into this many deterministic "
+        "shards, each host reading only its own span blocks "
+        "(default 0 = one shard per pod process)",
+    )
+    p.add_argument(
+        "--data-shard-id", type=int, default=None,
+        help="which shard THIS process streams (default -1 = "
+        "jax.process_index(); docs/DISTRIBUTED.md)",
+    )
+    p.add_argument(
+        "--data-seed", type=int, default=None,
+        help="seed of the epoch shuffle/shard permutations "
+        "(default -1 = the training --seed)",
+    )
+    p.add_argument(
+        "--input-prefetch", type=int, default=None,
+        help="host readahead depth in mix groups (each up to "
+        "mix_blocks*block-size rows) — the producer thread keeping "
+        "HDF5 reads ahead of batching (default 2; device staging "
+        "depth is TrainConfig.prefetch)",
+    )
+    p.add_argument(
+        "--data-block-size", type=int, default=None,
+        help="span-block granularity in rows: the unit the global "
+        "shuffle permutes and fast-forward skips (default 256)",
+    )
+    p.add_argument(
+        "--data-manifest", default=None, metavar="PATH",
+        help="pin the corpus index manifest to this path — a pinned "
+        "manifest that no longer matches the files on disk refuses "
+        "loudly with the per-file diff (default: sidecar next to the "
+        "corpus, rebuilt when stale)",
+    )
+
+
 def _guard_args(p: argparse.ArgumentParser) -> None:
     """Bulletproof-training sentinel knobs (GuardConfig,
     docs/TRAINING.md "Failure handling")."""
@@ -223,6 +263,12 @@ def _build_config(args: argparse.Namespace):
         seed="seed", in_memory="memory", val_fraction="val_fraction",
         dropout_rng_impl="dropout_rng_impl",
     )
+    data = over(
+        base.data,
+        shards="data_shards", shard_id="data_shard_id", seed="data_seed",
+        input_prefetch="input_prefetch", block_size="data_block_size",
+        manifest="data_manifest",
+    )
     mesh = over(base.mesh, dp="dp", tp="tp", sp="sp")
     serve = over(
         base.serve,
@@ -265,9 +311,9 @@ def _build_config(args: argparse.Namespace):
         guard = dataclasses.replace(guard, enabled=False)
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
-        model=model, train=train, mesh=mesh, serve=serve, fleet=fleet,
-        pipeline=pipeline, resilience=resilience, compile=compile_cfg,
-        guard=guard,
+        model=model, train=train, data=data, mesh=mesh, serve=serve,
+        fleet=fleet, pipeline=pipeline, resilience=resilience,
+        compile=compile_cfg, guard=guard,
     )
 
 
@@ -389,6 +435,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--coldstart-ladder", args.coldstart_ladder]
     if args.bench_iterations is not None:
         argv += ["--bench-iterations", str(args.bench_iterations)]
+    if args.input_rows is not None:
+        argv += ["--input-rows", str(args.input_rows)]
     if args.fleet_workers is not None:
         argv += ["--fleet-workers", args.fleet_workers]
     if args.compare is not None:
@@ -789,6 +837,7 @@ def build_parser() -> argparse.ArgumentParser:
     _model_args(p)
     _mesh_args(p)
     _window_args(p)
+    _data_args(p)
     _guard_args(p)
     p.set_defaults(fn=cmd_train)
 
@@ -894,6 +943,12 @@ def build_parser() -> argparse.ArgumentParser:
         "(req/s + p99 per count, scaling efficiency, req/s during a "
         "forced worker SIGKILL; default 1,2 when the e2e suite runs; "
         "0 disables)",
+    )
+    p.add_argument(
+        "--input-rows", type=int, default=None,
+        help="input suite fixed work: sim-corpus rows streamed through "
+        "the datapipe index layer vs the legacy streaming reader "
+        "(default 1536 when the e2e suite runs; 0 disables)",
     )
     p.add_argument(
         "--compare", default=None, metavar="BENCH_JSON",

@@ -147,6 +147,42 @@ class TrainConfig:
 
 
 @dataclass(frozen=True)
+class DataConfig:
+    """Deterministic sharded input data plane (roko_tpu/datapipe,
+    docs/TRAINING.md "Sharded input pipeline"): a seqio-style file-set
+    index with per-host shard streams that partition the global
+    shuffled stream exactly, sample-granular checkpointable iterators,
+    and streaming span reads with bounded host prefetch."""
+
+    #: number of data shards the corpus splits into; 0 = auto
+    #: (``jax.process_count()`` — one shard per pod host)
+    shards: int = 0
+    #: this process's shard; -1 = auto (``jax.process_index()``)
+    shard_id: int = -1
+    #: stream seed for the epoch shuffle/shard permutations; -1 = use
+    #: ``TrainConfig.seed`` (the historical behavior)
+    seed: int = -1
+    #: span-block granularity in rows: the unit the global shuffle
+    #: permutes, each host reads, and fast-forward skips
+    block_size: int = 256
+    #: cross-block mix-group width: each shard pools this many
+    #: consecutive permuted blocks and shuffles rows across the pool,
+    #: so a batch mixes up to this many random corpus regions (HDF5
+    #: corpora are locality-ordered); resident rows scale with
+    #: block_size * mix_blocks
+    mix_blocks: int = 8
+    #: bounded host readahead depth in MIX GROUPS — the producer thread
+    #: keeps up to this many decoded groups (each up to
+    #: ``mix_blocks * block_size`` rows) queued ahead of batching;
+    #: device staging depth stays ``TrainConfig.prefetch``
+    input_prefetch: int = 2
+    #: pinned manifest path. None = the default sidecar next to the
+    #: corpus (stale sidecars rebuild loudly; a PINNED manifest that
+    #: mismatches the files refuses with the per-file diff)
+    manifest: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Device mesh axes. dp shards the batch; tp shards the model
     (transformer variant); sp shards the sequence axis (ring attention)."""
@@ -362,6 +398,7 @@ class RokoConfig:
     region: RegionConfig = field(default_factory=RegionConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    data: DataConfig = field(default_factory=DataConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
@@ -383,6 +420,7 @@ class RokoConfig:
             model=ModelConfig(**{k: tuple(v) if k == "read_mlp" else v
                                  for k, v in raw.get("model", {}).items()}),
             train=TrainConfig(**raw.get("train", {})),
+            data=DataConfig(**raw.get("data", {})),
             mesh=MeshConfig(**raw.get("mesh", {})),
             serve=ServeConfig(**{k: tuple(v) if k == "ladder" else v
                                  for k, v in raw.get("serve", {}).items()}),

@@ -133,15 +133,41 @@ def data_group_names(fd: h5py.File) -> List[str]:
     return [g for g in fd.keys() if g not in ("contigs", "info")]
 
 
+def file_identity(path: str):
+    """Filesystem identity for duplicate detection: (device, inode) —
+    which collapses symlinked/hardlinked aliases of the same file — or
+    the realpath when stat fails. Shared by :func:`hdf5_files` and the
+    datapipe manifest's :func:`resolve_file_set`."""
+    try:
+        st = os.stat(path)
+        return (st.st_dev, st.st_ino)
+    except OSError:
+        return os.path.realpath(path)
+
+
 def hdf5_files(path: str) -> List[str]:
-    """A single file, or every ``*.hdf5`` in a directory
-    (ref: roko/datasets.py:9-17)."""
+    """A single file, or every ``*.hdf5``/``*.h5`` in a directory
+    (ref: roko/datasets.py:9-17).
+
+    Directory listings sort lexicographically by BASENAME (not the
+    joined path, and never the filesystem's enumeration order) and drop
+    symlinked duplicates by :func:`file_identity` — the datapipe
+    manifest and shard assignment are pure functions of this list, so
+    it must resolve identically on every host and filesystem
+    (roko_tpu/datapipe/manifest.py)."""
     if os.path.isdir(path):
-        return sorted(
-            os.path.join(path, f)
-            for f in os.listdir(path)
-            if f.endswith(".hdf5") or f.endswith(".h5")
-        )
+        out: List[str] = []
+        seen: set = set()
+        for f in sorted(os.listdir(path)):
+            if not (f.endswith(".hdf5") or f.endswith(".h5")):
+                continue
+            p = os.path.join(path, f)
+            ident = file_identity(p)
+            if ident in seen:
+                continue  # symlinked duplicate of an already-listed file
+            seen.add(ident)
+            out.append(p)
+        return out
     return [path]
 
 

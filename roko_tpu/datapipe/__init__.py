@@ -1,0 +1,53 @@
+"""Deterministic sharded input data plane (ROADMAP item 5).
+
+A seqio/t5x-style input layer between the HDF5 feature files on disk and
+the device train step (docs/TRAINING.md "Sharded input pipeline"):
+
+- ``manifest.py`` — the index layer: scans an HDF5 file set (file, dir,
+  or list of paths/globs) into a persistent manifest of (file, group,
+  row-range) spans with sizes and a content fingerprint, so shard
+  assignment is a pure function of (manifest, num_shards, shard_id,
+  seed) and a mutated/diverged corpus is refused loudly instead of
+  silently changing the stream.
+- ``engine.py`` — the shuffle/shard/batch engine: global shuffle
+  without a global read (seeded block permutation + per-block row
+  permutations derived from per-block seeds), strided shard assignment
+  whose union over shards is exactly the 1-shard stream, O(spans
+  skipped) fast-forward, bounded host prefetch, and a read-accounting
+  hook proving the corpus is never materialised.
+- ``dataset.py`` — :class:`ShardedDataset`: the manifest-backed dataset
+  the training loop consumes (single-host and dp-mesh pods), with a
+  sample-granular checkpointable iterator (``state()``/``restore``)
+  wired into the checkpoint ``data_state``.
+
+The two legacy datasets (``training/data.py`` InMemoryDataset,
+``training/lazy_data.py`` StreamingDataset) keep their public paths but
+delegate ``batches(..., skip_batches=)`` to this engine.
+"""
+
+from roko_tpu.datapipe.dataset import CheckpointableIterator, ShardedDataset
+from roko_tpu.datapipe.engine import ReadStats, epoch_schedule, iter_span_batches
+from roko_tpu.datapipe.manifest import (
+    MANIFEST_BASENAME,
+    Manifest,
+    ManifestError,
+    ManifestMismatch,
+    build_manifest,
+    load_or_build_manifest,
+    resolve_file_set,
+)
+
+__all__ = [
+    "CheckpointableIterator",
+    "ShardedDataset",
+    "ReadStats",
+    "epoch_schedule",
+    "iter_span_batches",
+    "MANIFEST_BASENAME",
+    "Manifest",
+    "ManifestError",
+    "ManifestMismatch",
+    "build_manifest",
+    "load_or_build_manifest",
+    "resolve_file_set",
+]

@@ -1,0 +1,387 @@
+"""ShardedDataset: the manifest-backed dataset the training loop
+consumes, plus the sample-granular checkpointable iterator.
+
+One dataset object = one (corpus, shard) view. The stream is a pure
+function of (manifest fingerprint, num_shards, shard_id, seed, epoch):
+every host derives the same global order and reads only its own span
+blocks, so a pod needs no data coordination beyond agreeing on the
+manifest — and a killed-and-resumed run replays bit-identically from
+any sample position (docs/TRAINING.md "Sharded input pipeline").
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from roko_tpu.datapipe import engine as _engine
+from roko_tpu.datapipe.manifest import (
+    DEFAULT_BLOCK_SIZE,
+    Manifest,
+    load_or_build_manifest,
+)
+
+
+class ShardedDataset:
+    """Deterministic sharded view over an HDF5 file set.
+
+    ``batches`` keeps the legacy ``(x, y, w)`` iterator contract of
+    InMemoryDataset/StreamingDataset (the train loop and ``evaluate``
+    treat all three interchangeably); ``iterator`` wraps it in a
+    :class:`CheckpointableIterator` with ``state()``/``restore``.
+    """
+
+    def __init__(
+        self,
+        path: Union[str, Sequence[str]],
+        *,
+        num_shards: int = 1,
+        shard_id: int = 0,
+        seed: int = 0,
+        block_size: Optional[int] = None,
+        prefetch_blocks: int = 2,
+        mix_blocks: int = _engine.DEFAULT_MIX_BLOCKS,
+        preload: bool = False,
+        manifest_path: Optional[str] = None,
+        require_labels: bool = True,
+        log=None,
+        manifest: Optional[Manifest] = None,
+        paths: Optional[List[str]] = None,
+    ) -> None:
+        if not 0 <= shard_id < num_shards:
+            raise ValueError(
+                f"shard_id {shard_id} outside [0, num_shards={num_shards})"
+            )
+        if manifest is None:
+            manifest, paths = load_or_build_manifest(
+                path,
+                manifest_path=manifest_path,
+                block_size=block_size or DEFAULT_BLOCK_SIZE,
+                require_labels=require_labels,
+                log=log,
+            )
+            # (load_or_build_manifest already verified the files on the
+            # load path and scanned exactly these on the build path —
+            # no second verification pass.) span.file_idx indexes
+            # manifest.files; re-key the resolved paths into that order.
+            by_name = {os.path.basename(p): p for p in paths}
+            paths = [by_name[fe.name] for fe in manifest.files]
+        self.manifest = manifest
+        self.paths: List[str] = list(paths or [])
+        self.num_shards = num_shards
+        self.shard_id = shard_id
+        self.seed = seed
+        self.prefetch_blocks = prefetch_blocks
+        self.mix_blocks = mix_blocks
+        self._spans = manifest.spans(block_size)
+        #: per-span kept-row indices (holdout views); None = all rows
+        self._kept: Optional[List[Optional[np.ndarray]]] = None
+        self._arrays: Optional[Dict[Tuple[int, str], Tuple[np.ndarray, np.ndarray]]] = None
+        if preload:
+            self._preload()
+
+    # -- backends ----------------------------------------------------
+
+    def _preload(self) -> None:
+        """Load every (file, group) into host RAM once (the --memory
+        path). The stream stays byte-identical to the disk-backed one:
+        both read through the same span plan."""
+        import h5py
+
+        arrays: Dict[Tuple[int, str], Tuple[np.ndarray, np.ndarray]] = {}
+        for fi, p in enumerate(self.paths):
+            with h5py.File(p, "r") as fd:
+                for g, _rows in self.manifest.files[fi].groups:
+                    x = np.ascontiguousarray(fd[g]["examples"][()])
+                    y = np.ascontiguousarray(fd[g]["labels"][()], np.int32)
+                    arrays[(fi, g)] = (x, y)
+        self._arrays = arrays
+
+    def _counts(self) -> List[int]:
+        return [s.count for s in self._spans]
+
+    # -- sizes -------------------------------------------------------
+
+    def __len__(self) -> int:
+        """GLOBAL kept rows across all shards (what the loop logs)."""
+        if self._kept is None:
+            return sum(s.count for s in self._spans)
+        return sum(
+            len(k) if k is not None else s.count
+            for s, k in zip(self._spans, self._kept)
+        )
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._spans)
+
+    def local_rows(self) -> int:
+        """Rows this shard owns (fixed across epochs — canonical
+        modulo block assignment)."""
+        counts = self._effective_counts()
+        return _engine.shard_row_counts(counts, self.num_shards)[self.shard_id]
+
+    def _effective_counts(self) -> List[int]:
+        if self._kept is None:
+            return self._counts()
+        return [
+            len(k) if k is not None else s.count
+            for s, k in zip(self._spans, self._kept)
+        ]
+
+    def steps_per_epoch(
+        self, batch_size: int, *, drop_remainder: bool = False
+    ) -> int:
+        """Equalised per-shard batch count (max over shards): every
+        shard emits exactly this many batches per epoch, padding with
+        zero-weight batches if its rows run out first, so lockstep
+        collectives on a pod cannot starve."""
+        return _engine.batches_per_epoch(
+            self._effective_counts(),
+            batch_size,
+            self.num_shards,
+            drop_remainder=drop_remainder,
+        )
+
+    # -- reading -----------------------------------------------------
+
+    def _row_template(self) -> Tuple[tuple, str, tuple, str]:
+        # labels always surface as int32 (see read_rows/_preload)
+        m = self.manifest
+        return (m.x_shape, m.x_dtype, m.y_shape, "int32")
+
+    def batches(
+        self,
+        batch_size: int,
+        *,
+        rng: Optional[np.random.Generator] = None,
+        drop_remainder: bool = False,
+        pad_to: Optional[int] = None,
+        skip_batches: int = 0,
+        start_samples: Optional[int] = None,
+        stats: Optional[_engine.ReadStats] = None,
+        equalize: Optional[bool] = None,
+    ) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Legacy-contract batch iterator over THIS shard's stream.
+
+        ``equalize`` (default: on for multi-shard runs with ``pad_to``)
+        pads the emitted batch count up to :meth:`steps_per_epoch`.
+        Fast-forward via ``skip_batches``/``start_samples`` is O(spans
+        skipped): skipped blocks are never read.
+        """
+        if equalize is None:
+            equalize = self.num_shards > 1 and pad_to is not None
+        min_batches = None
+        if equalize:
+            start = (
+                int(start_samples)
+                if start_samples is not None
+                else skip_batches * batch_size
+            )
+            min_batches = self.steps_per_epoch(
+                batch_size, drop_remainder=drop_remainder
+            ) - start // batch_size
+        import h5py
+
+        fds: dict = {}
+
+        def read_rows(b: int, order: np.ndarray):
+            span = self._spans[b]
+            if self._arrays is not None:
+                x, y = self._arrays[(span.file_idx, span.group)]
+                sel = span.start + order
+                return x[sel], y[sel]
+            fd = fds.get(span.file_idx)
+            if fd is None:
+                fd = fds[span.file_idx] = h5py.File(
+                    self.paths[span.file_idx], "r"
+                )
+            g = fd[span.group]
+            lo, hi = span.start, span.start + span.count
+            # one contiguous block read, then in-RAM permute: streaming
+            # I/O for HDF5, shuffle quality from the index layer. Label
+            # dtype pins to int32 (the device dtype) so streamed, pre-
+            # loaded, and synthesised padding batches all agree.
+            x = np.asarray(g["examples"][lo:hi])
+            y = np.asarray(g["labels"][lo:hi], np.int32)
+            return x[order], y[order]
+
+        def close_fds():
+            for fd in fds.values():
+                fd.close()
+            fds.clear()
+
+        # cleanup rides the engine's block generator so it runs in the
+        # thread doing the reads (the prefetch producer) — a consumer-
+        # side finally here would race fd.close against in-flight reads
+        yield from _engine.iter_span_batches(
+            self._counts(),
+            read_rows,
+            batch_size,
+            rng=rng,
+            num_shards=self.num_shards,
+            shard_id=self.shard_id,
+            kept=self._kept,
+            drop_remainder=drop_remainder,
+            pad_to=pad_to,
+            skip_batches=skip_batches,
+            start_samples=start_samples,
+            min_batches=min_batches,
+            prefetch=0 if self._arrays is not None else self.prefetch_blocks,
+            mix_blocks=self.mix_blocks,
+            stats=stats,
+            row_template=self._row_template(),
+            cleanup=close_fds,
+        )
+
+    def epoch_rng(self, epoch: int) -> np.random.Generator:
+        """The per-epoch stream rng — same ``(seed, epoch)`` derivation
+        the training loop has always used, so epoch E shuffles
+        identically whether or not the run was interrupted inside it."""
+        return np.random.default_rng(np.random.SeedSequence([self.seed, epoch]))
+
+    def iterator(
+        self,
+        epoch: int,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        pad_to: Optional[int] = None,
+        drop_remainder: bool = False,
+        start_batch: int = 0,
+        start_samples: Optional[int] = None,
+        stats: Optional[_engine.ReadStats] = None,
+    ) -> "CheckpointableIterator":
+        return CheckpointableIterator(
+            self,
+            epoch,
+            batch_size,
+            shuffle=shuffle,
+            pad_to=pad_to,
+            drop_remainder=drop_remainder,
+            start_batch=start_batch,
+            start_samples=start_samples,
+            stats=stats,
+        )
+
+    def unsharded(self) -> "ShardedDataset":
+        """A 1-shard view of the same corpus (same backend, same kept
+        rows): what evaluation uses so every host sees the identical
+        stream regardless of the train shard spec."""
+        if self.num_shards == 1:
+            return self
+        view = copy.copy(self)
+        view.num_shards, view.shard_id = 1, 0
+        return view
+
+    # -- holdout -----------------------------------------------------
+
+    def split_holdout(
+        self, fraction: float, seed: int
+    ) -> Tuple["ShardedDataset", "ShardedDataset"]:
+        """Deterministic row-level (train, val) split, identical on
+        every host: a seeded global permutation holds out
+        ``max(1, round(fraction * N))`` rows. The val view is always
+        unsharded (every host evaluates the identical full holdout);
+        the train view keeps this dataset's shard spec."""
+        if not 0.0 < fraction < 1.0:
+            raise ValueError(f"val fraction must be in (0, 1), got {fraction}")
+        n = len(self)
+        if self._kept is not None:
+            raise ValueError("cannot split an already-split dataset view")
+        n_val = max(1, round(fraction * n))
+        if n_val >= n:
+            raise ValueError(
+                f"val fraction {fraction} leaves no training windows (N={n})"
+            )
+        perm = np.random.default_rng(seed).permutation(n)
+        val_mask = np.zeros(n, bool)
+        val_mask[perm[:n_val]] = True
+        kept_train: List[Optional[np.ndarray]] = []
+        kept_val: List[Optional[np.ndarray]] = []
+        off = 0
+        for s in self._spans:
+            m = val_mask[off : off + s.count]
+            kept_val.append(np.nonzero(m)[0].astype(np.int64))
+            kept_train.append(np.nonzero(~m)[0].astype(np.int64))
+            off += s.count
+
+        train = copy.copy(self)
+        train._kept = kept_train
+        val = copy.copy(self)
+        val._kept = kept_val
+        val.num_shards, val.shard_id = 1, 0
+        return train, val
+
+
+class CheckpointableIterator:
+    """Sample-granular checkpointable epoch iterator.
+
+    ``state()`` returns ``{"epoch", "batch", "samples"}`` — the exact
+    position in the shard's epoch stream — and ``restore`` rebuilds an
+    iterator that continues bit-identically from it, in O(spans
+    skipped) (no prefix re-read). The training loop persists the same
+    coordinates in the checkpoint's ``data_state``.
+    """
+
+    def __init__(
+        self,
+        dataset: ShardedDataset,
+        epoch: int,
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        pad_to: Optional[int] = None,
+        drop_remainder: bool = False,
+        start_batch: int = 0,
+        start_samples: Optional[int] = None,
+        stats=None,
+    ) -> None:
+        self.dataset = dataset
+        self.epoch = int(epoch)
+        self.batch_size = int(batch_size)
+        self._samples = (
+            int(start_samples)
+            if start_samples is not None
+            else start_batch * batch_size
+        )
+        self._batch = self._samples // batch_size
+        self._gen = dataset.batches(
+            batch_size,
+            rng=dataset.epoch_rng(epoch) if shuffle else None,
+            pad_to=pad_to,
+            drop_remainder=drop_remainder,
+            start_samples=self._samples,
+            stats=stats,
+        )
+
+    def __iter__(self) -> "CheckpointableIterator":
+        return self
+
+    def __next__(self):
+        batch = next(self._gen)
+        self._batch += 1
+        self._samples += self.batch_size
+        return batch
+
+    def state(self) -> Dict[str, int]:
+        return {
+            "epoch": self.epoch,
+            "batch": self._batch,
+            "samples": self._samples,
+        }
+
+    @staticmethod
+    def restore(
+        dataset: ShardedDataset, state: Dict[str, int], batch_size: int, **kw
+    ) -> "CheckpointableIterator":
+        return CheckpointableIterator(
+            dataset,
+            int(state["epoch"]),
+            batch_size,
+            start_samples=int(state["samples"]),
+            **kw,
+        )

@@ -1,0 +1,341 @@
+"""Shuffle/shard/batch engine: global shuffle without a global read.
+
+The epoch stream over a span table is defined by three pure functions
+of (rng, num_shards, shard_id):
+
+1. **block order** — one seeded permutation over span blocks;
+2. **row order** — a per-block permutation derived from a per-block
+   seed (the seeds are drawn in canonical block order, so every shard
+   — and a host simulating another shard — consumes the rng
+   identically and can reproduce any block's rows without reading it);
+3. **shard assignment** — block ``b`` belongs to shard
+   ``b % num_shards`` (canonical id, not permuted position): per-shard
+   row counts are fixed across epochs, and the shard streams cover the
+   corpus disjointly — their union is exactly the 1-shard stream as a
+   multiset;
+4. **mix groups** — each shard pools ``mix_blocks`` consecutive blocks
+   of its permuted sequence and applies one seeded permutation across
+   the pool, so a batch mixes rows from up to ``mix_blocks`` random
+   corpus regions instead of 1-2 disk-adjacent ones (HDF5 corpora are
+   written contig-by-contig; without this, every batch would be
+   locality-correlated — the within-batch diversity the legacy
+   shuffle-buffer reader provided).
+
+A host therefore reads only its own blocks (sequential HDF5 span
+reads), holds at most a mix group of rows at any moment (asserted via
+:class:`ReadStats`), and fast-forwards to any sample position in
+O(spans skipped) — wholly-skipped mix groups are never read.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ReadStats:
+    """Read-accounting hook for the index reader: how many rows were
+    actually read from disk, and the high-water mark of rows resident
+    on the host at any moment — the assertion that global shuffle
+    never materialises the corpus.
+
+    Residency is measured as ``rows_read - rows_emitted``: every row
+    read but not yet handed out in a batch, INCLUDING rows sitting in
+    the prefetch queue between the producer and consumer threads (an
+    earlier consumer-buffer-only count under-reported by the queue
+    depth). The two counters are bumped from different threads; int
+    increments are GIL-atomic and a high-water mark tolerates the
+    benign race."""
+
+    def __init__(self) -> None:
+        self.rows_read = 0
+        self.rows_emitted = 0
+        self.blocks_read = 0
+        self.batches = 0
+        self.max_resident_rows = 0
+
+    def note_read(self, rows: int) -> None:
+        self.rows_read += int(rows)
+        self.blocks_read += 1
+        self._note_resident()
+
+    def note_emitted(self, rows: int) -> None:
+        self.rows_emitted += int(rows)
+
+    def _note_resident(self) -> None:
+        resident = self.rows_read - self.rows_emitted
+        if resident > self.max_resident_rows:
+            self.max_resident_rows = int(resident)
+
+    def note_batch(self) -> None:
+        self.batches += 1
+        self._note_resident()
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """One shard's epoch schedule: its blocks in global-stream order
+    plus the per-block row-permutation seeds (for ALL blocks — any
+    shard's rows are reproducible from the schedule alone)."""
+
+    mine: Tuple[int, ...]  # this shard's block ids, in permuted order
+    seeds: Optional[np.ndarray]  # per-block row-perm seeds; None = no shuffle
+    counts: Tuple[int, ...]  # effective rows per block (post-holdout)
+
+    def row_order(self, block: int, kept: Optional[np.ndarray] = None) -> np.ndarray:
+        """Row emission order WITHIN ``block`` (indices into the span's
+        rows). ``kept`` restricts to a holdout-filtered subset."""
+        base = (
+            np.asarray(kept)
+            if kept is not None
+            else np.arange(self.counts[block])
+        )
+        if self.seeds is None:
+            return base
+        perm = np.random.default_rng(int(self.seeds[block])).permutation(len(base))
+        return base[perm]
+
+    def shard_rows(self) -> int:
+        return sum(self.counts[b] for b in self.mine)
+
+
+def epoch_schedule(
+    counts: Sequence[int],
+    rng: Optional[np.random.Generator],
+    *,
+    num_shards: int = 1,
+    shard_id: int = 0,
+) -> Schedule:
+    """Build one epoch's schedule. The rng is consumed identically for
+    every (num_shards, shard_id) — one block permutation plus one seed
+    per block, both over ALL blocks in canonical order — so shard
+    streams partition the 1-shard stream exactly."""
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(f"shard_id {shard_id} outside [0, {num_shards})")
+    n = len(counts)
+    if rng is None:
+        order = np.arange(n)
+        seeds = None
+    else:
+        order = rng.permutation(n)
+        # canonical-order draw: O(blocks) state, independent of which
+        # shard is asking; the per-block perms materialise lazily only
+        # for blocks actually read
+        seeds = rng.integers(0, np.iinfo(np.int64).max, size=n, dtype=np.int64)
+    mine = tuple(int(b) for b in order if b % num_shards == shard_id)
+    return Schedule(mine=mine, seeds=seeds, counts=tuple(int(c) for c in counts))
+
+
+def shard_row_counts(counts: Sequence[int], num_shards: int) -> List[int]:
+    """Fixed per-shard row totals (canonical modulo assignment)."""
+    totals = [0] * num_shards
+    for b, c in enumerate(counts):
+        totals[b % num_shards] += int(c)
+    return totals
+
+
+def batches_per_epoch(
+    counts: Sequence[int],
+    batch_size: int,
+    num_shards: int = 1,
+    *,
+    drop_remainder: bool = False,
+) -> int:
+    """The equalised step count every shard must emit — the max over
+    shards of its own batch count, so collective-issuing training
+    loops stay in lockstep (shards short on rows pad with zero-weight
+    batches)."""
+    per = []
+    for rows in shard_row_counts(counts, num_shards):
+        per.append(rows // batch_size if drop_remainder else -(-rows // batch_size))
+    return max(per) if per else 0
+
+
+def _zero_batch(
+    batch_size: int, row_template: Tuple[tuple, str, tuple, str]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    x_shape, x_dtype, y_shape, y_dtype = row_template
+    x = np.zeros((batch_size,) + tuple(x_shape), np.dtype(x_dtype))
+    y = np.zeros((batch_size,) + tuple(y_shape), np.dtype(y_dtype or np.int32))
+    return x, y, np.zeros(batch_size, np.float32)
+
+
+#: default cross-block mix-group width: a batch draws from up to this
+#: many randomly-permuted blocks (8 x 256-row default blocks = a
+#: 2048-row pool, the scale of the legacy reader's shuffle buffer)
+DEFAULT_MIX_BLOCKS = 8
+
+
+def iter_span_batches(
+    counts: Sequence[int],
+    read_rows: Callable[[int, np.ndarray], Tuple[np.ndarray, np.ndarray]],
+    batch_size: int,
+    *,
+    rng: Optional[np.random.Generator] = None,
+    num_shards: int = 1,
+    shard_id: int = 0,
+    kept: Optional[Sequence[Optional[np.ndarray]]] = None,
+    drop_remainder: bool = False,
+    pad_to: Optional[int] = None,
+    skip_batches: int = 0,
+    start_samples: Optional[int] = None,
+    min_batches: Optional[int] = None,
+    prefetch: int = 0,
+    mix_blocks: int = DEFAULT_MIX_BLOCKS,
+    stats: Optional[ReadStats] = None,
+    row_template: Optional[Tuple[tuple, str, tuple, str]] = None,
+    cleanup: Optional[Callable[[], None]] = None,
+) -> Iterator[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """Yield (x, y, weight) batches of this shard's slice of the epoch
+    stream. Same (x, y, w) contract as the legacy datasets' ``batches``.
+
+    ``read_rows(block, order)`` returns the block's rows in emission
+    order — the ONLY place data bytes move; everything else is index
+    arithmetic, which is what makes ``skip_batches``/``start_samples``
+    fast-forward O(spans skipped): whole skipped blocks are counted,
+    never read.
+
+    ``min_batches`` (with ``pad_to``) equalises the emitted batch count
+    across shards: a shard that runs out of rows emits all-padding
+    zero-weight batches so lockstep collectives on a pod never starve.
+
+    ``cleanup`` (close file handles, release buffers) runs when the
+    BLOCK generator finishes or is closed — i.e. in the same thread
+    that called ``read_rows``. With ``prefetch`` the reads happen on
+    the producer thread, so a consumer-side ``finally`` would race a
+    close against an in-flight read; this hook cannot.
+    """
+    eff_counts = (
+        [len(k) if k is not None else int(c) for c, k in zip(counts, kept)]
+        if kept is not None
+        else [int(c) for c in counts]
+    )
+    sched = epoch_schedule(
+        eff_counts, rng, num_shards=num_shards, shard_id=shard_id
+    )
+    start = (
+        int(start_samples)
+        if start_samples is not None
+        else skip_batches * batch_size
+    )
+
+    # this shard's permuted block sequence, pooled into mix groups of
+    # up to mix_blocks blocks; each group is an atomic stream unit
+    width = max(1, mix_blocks)
+    groups = [
+        sched.mine[i : i + width] for i in range(0, len(sched.mine), width)
+    ]
+
+    def _group_rows(group) -> Tuple[np.ndarray, np.ndarray]:
+        """Read one mix group and permute rows ACROSS its blocks (one
+        seeded draw — deterministic, shard-local, index-only)."""
+        xs, ys = [], []
+        for b in group:
+            if sched.counts[b] == 0:
+                continue
+            order = sched.row_order(b, kept[b] if kept is not None else None)
+            x, y = read_rows(b, order)
+            if stats is not None:
+                stats.note_read(len(order))
+            xs.append(x)
+            ys.append(y)
+        x = xs[0] if len(xs) == 1 else np.concatenate(xs)
+        y = ys[0] if len(ys) == 1 else np.concatenate(ys)
+        if sched.seeds is not None and len(xs) > 1:
+            perm = np.random.default_rng(
+                np.random.SeedSequence([int(sched.seeds[group[0]]), 1])
+            ).permutation(len(x))
+            x, y = x[perm], y[perm]
+        return x, y
+
+    def _blocks() -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        try:
+            pos = 0
+            for group in groups:
+                size = sum(sched.counts[b] for b in group)
+                if size == 0:
+                    continue
+                if pos + size <= start:
+                    pos += size  # fast-forward: whole group skipped, never read
+                    continue
+                x, y = _group_rows(group)
+                if pos < start:
+                    # the sliced-off prefix was read but will never be
+                    # emitted — credit it, or every later residency
+                    # sample would carry the discarded rows forever
+                    if stats is not None:
+                        stats.note_emitted(start - pos)
+                    x, y = x[start - pos :], y[start - pos :]
+                pos += size
+                yield x, y
+        finally:
+            if cleanup is not None:
+                cleanup()
+
+    stream: Iterator = _blocks()
+    if prefetch > 0:
+        # bounded host readahead: the block reads run in a producer
+        # thread while the consumer batches/places — the same helper
+        # that stages device batches (training/data.py)
+        from roko_tpu.training.data import prefetch_to_device
+
+        stream = prefetch_to_device(stream, prefetch, lambda item: item)
+
+    emitted = 0
+    buf_x: List[np.ndarray] = []
+    buf_y: List[np.ndarray] = []
+    held = 0
+
+    def _cut(n: int) -> Tuple[np.ndarray, np.ndarray]:
+        nonlocal buf_x, buf_y, held
+        x = buf_x[0] if len(buf_x) == 1 else np.concatenate(buf_x)
+        y = buf_y[0] if len(buf_y) == 1 else np.concatenate(buf_y)
+        out = x[:n], y[:n]
+        buf_x = [x[n:]] if len(x) > n else []
+        buf_y = [y[n:]] if len(y) > n else []
+        held = max(0, len(x) - n)
+        return out
+
+    def _emit(x, y, w, real_rows):
+        nonlocal emitted
+        emitted += 1
+        if stats is not None:
+            stats.note_emitted(real_rows)
+            stats.note_batch()
+        return x, y, w
+
+    for x, y in stream:
+        buf_x.append(x)
+        buf_y.append(y)
+        held += len(x)
+        while held >= batch_size:
+            xb, yb = _cut(batch_size)
+            yield _emit(
+                xb, yb, np.ones(batch_size, np.float32), batch_size
+            )
+    if held:
+        xb, yb = _cut(held)
+        real = len(xb)
+        if drop_remainder:
+            pass
+        elif pad_to is not None:
+            pad = pad_to - len(xb)
+            w = np.concatenate(
+                [np.ones(len(xb), np.float32), np.zeros(pad, np.float32)]
+            )
+            if pad > 0:
+                xb = np.concatenate([xb, np.zeros((pad,) + xb.shape[1:], xb.dtype)])
+                yb = np.concatenate([yb, np.zeros((pad,) + yb.shape[1:], yb.dtype)])
+            yield _emit(xb, yb, w, real)
+        else:
+            yield _emit(xb, yb, np.ones(len(xb), np.float32), real)
+    if min_batches is not None and emitted < min_batches:
+        if pad_to is None or row_template is None:
+            raise ValueError(
+                "min_batches needs pad_to and row_template to synthesise "
+                "padding batches"
+            )
+        while emitted < min_batches:
+            yield _emit(*_zero_batch(pad_to, row_template), 0)

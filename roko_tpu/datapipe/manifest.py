@@ -1,0 +1,469 @@
+"""Index layer: a persistent manifest over an HDF5 training file set.
+
+The manifest records, per file, the lexicographically-sorted basename,
+byte size, content digests, and per-group row counts, plus the fixed
+(file, group, row-range) span table cut at ``block_size`` rows — the
+unit the shuffle/shard engine permutes. Everything downstream (shard
+assignment, epoch order, fast-forward) is a pure function of
+(manifest, num_shards, shard_id, seed), which is what makes sharded
+kill-and-resume bit-identical and lets every host agree on the stream
+without talking to each other.
+
+Two digests per file:
+
+- ``sha256`` — the full content hash, computed once at build time (the
+  manifest is persistent precisely so this cost is paid once);
+- ``sample_sha256`` — size + first/middle/last MiB, cheap enough to
+  re-check at every open. Verification uses the sample digest; a
+  mutation inside an untouched-size file larger than ~3 MiB can evade
+  it between full verifies, but every re-extraction, truncation,
+  append, or file swap is caught at open time.
+
+A stale *default* sidecar manifest (the corpus was legitimately
+regenerated in place) is rebuilt with a loud log line; an *explicitly
+pinned* manifest (``--data-manifest`` / ``manifest_path=``) that no
+longer matches the files refuses with the per-file diff — pinning is
+how a resumed or multi-host run asserts "the corpus I trained on".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+MANIFEST_BASENAME = "roko_datapipe_manifest.json"
+MANIFEST_VERSION = 1
+#: bytes hashed per stripe by the cheap open-time sample digest
+SAMPLE_BYTES = 1 << 20
+#: default span-block granularity (rows); matches the legacy streaming
+#: chunk size — big enough for streaming HDF5 reads, small enough that
+#: block-granular shuffle approaches a global permutation
+DEFAULT_BLOCK_SIZE = 256
+
+
+class ManifestError(RuntimeError):
+    """Manifest build/load failure (no inputs, inconsistent geometry...)."""
+
+
+class ManifestMismatch(ManifestError):
+    """The file set on disk does not match the manifest (missing/extra/
+    changed files); message carries the per-path diff."""
+
+
+def resolve_file_set(spec: Union[str, Sequence[str]]) -> List[str]:
+    """Resolve a file, directory, or list of paths/globs into the
+    canonical file set: lexicographic by basename (stable across hosts
+    and filesystems — directory enumeration order is not), symlinked
+    duplicates removed by ``data.hdf5.file_identity``."""
+    from roko_tpu.data.hdf5 import file_identity, hdf5_files
+
+    specs = [spec] if isinstance(spec, str) else list(spec)
+    if not specs:
+        raise ManifestError("empty input file-set spec")
+    found: List[str] = []
+    for s in specs:
+        if os.path.isdir(s) or os.path.isfile(s):
+            found.extend(hdf5_files(s))
+        else:
+            matches = sorted(_glob.glob(s))
+            if not matches:
+                raise ManifestError(f"no HDF5 inputs match {s!r}")
+            for m in matches:
+                found.extend(hdf5_files(m))
+    out: List[str] = []
+    seen: set = set()
+    for p in sorted(found, key=lambda p: (os.path.basename(p), p)):
+        ident = file_identity(p)
+        if ident in seen:
+            continue  # symlinked/duplicate path to the same file
+        seen.add(ident)
+        out.append(p)
+    if not out:
+        raise ManifestError(f"no HDF5 inputs under {spec!r}")
+    return out
+
+
+def _sample_digest(path: str) -> str:
+    """sha256 over (size, first/middle/last SAMPLE_BYTES stripes)."""
+    size = os.path.getsize(path)
+    h = hashlib.sha256(str(size).encode())
+    with open(path, "rb") as f:
+        offsets = {0, max(0, size // 2 - SAMPLE_BYTES // 2), max(0, size - SAMPLE_BYTES)}
+        for off in sorted(offsets):
+            f.seek(off)
+            h.update(f.read(SAMPLE_BYTES))
+    return h.hexdigest()
+
+
+def _full_digest(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 22), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+@dataclasses.dataclass(frozen=True)
+class FileEntry:
+    name: str  # basename — the cross-host identity (roots differ)
+    size: int
+    sha256: str  # full content (build-time)
+    sample_sha256: str  # cheap open-time check
+    groups: Tuple[Tuple[str, int], ...]  # (group name, rows)
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One fixed-size block of consecutive rows inside (file, group) —
+    the unit the shuffle/shard engine permutes and the reader reads."""
+
+    file_idx: int
+    group: str
+    start: int
+    count: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Manifest:
+    files: Tuple[FileEntry, ...]
+    block_size: int
+    labeled: bool
+    x_shape: Tuple[int, ...]  # per-row example shape
+    x_dtype: str
+    y_shape: Tuple[int, ...]  # per-row label shape (() when unlabeled)
+    y_dtype: str
+
+    @property
+    def total_rows(self) -> int:
+        return sum(r for fe in self.files for _, r in fe.groups)
+
+    @property
+    def fingerprint(self) -> str:
+        """Corpus identity: digest over the per-file entries (content
+        digests included). Independent of block_size — recutting spans
+        does not change what corpus this is."""
+        blob = json.dumps(
+            [
+                [fe.name, fe.size, fe.sha256, list(map(list, fe.groups))]
+                for fe in self.files
+            ],
+            sort_keys=True,
+        )
+        return hashlib.sha256(blob.encode()).hexdigest()
+
+    def fingerprint32_pair(self) -> Tuple[int, int]:
+        """The fingerprint's first 64 bits as two signed int32s — the
+        form that survives a jax/orbax checkpoint round-trip with x64
+        disabled (``data_state.pipe`` in training/loop.py)."""
+        v = int(self.fingerprint[:16], 16)
+        hi, lo = (v >> 32) & 0xFFFFFFFF, v & 0xFFFFFFFF
+        return (hi - (1 << 32) if hi >= 1 << 31 else hi,
+                lo - (1 << 32) if lo >= 1 << 31 else lo)
+
+    def spans(self, block_size: Optional[int] = None) -> List[Span]:
+        """The (file, group, row-range) span table at ``block_size``
+        granularity (default: the manifest's own)."""
+        bs = block_size or self.block_size
+        out: List[Span] = []
+        for fi, fe in enumerate(self.files):
+            for g, rows in fe.groups:
+                for start in range(0, rows, bs):
+                    out.append(Span(fi, g, start, min(bs, rows - start)))
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": MANIFEST_VERSION,
+            "block_size": self.block_size,
+            "labeled": self.labeled,
+            "x_shape": list(self.x_shape),
+            "x_dtype": self.x_dtype,
+            "y_shape": list(self.y_shape),
+            "y_dtype": self.y_dtype,
+            "fingerprint": self.fingerprint,
+            "files": [
+                {
+                    "name": fe.name,
+                    "size": fe.size,
+                    "sha256": fe.sha256,
+                    "sample_sha256": fe.sample_sha256,
+                    "groups": [[g, r] for g, r in fe.groups],
+                }
+                for fe in self.files
+            ],
+        }
+
+    @staticmethod
+    def from_dict(raw: Dict[str, Any]) -> "Manifest":
+        files = tuple(
+            FileEntry(
+                name=f["name"],
+                size=int(f["size"]),
+                sha256=f["sha256"],
+                sample_sha256=f["sample_sha256"],
+                groups=tuple((g, int(r)) for g, r in f["groups"]),
+            )
+            for f in raw["files"]
+        )
+        return Manifest(
+            files=files,
+            block_size=int(raw["block_size"]),
+            labeled=bool(raw["labeled"]),
+            x_shape=tuple(raw["x_shape"]),
+            x_dtype=raw["x_dtype"],
+            y_shape=tuple(raw["y_shape"]),
+            y_dtype=raw["y_dtype"],
+        )
+
+    def save(self, path: str) -> None:
+        """Atomic write (tmp + fsync + rename), same discipline as the
+        checkpoint integrity manifests."""
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    @staticmethod
+    def load(path: str) -> "Manifest":
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except (OSError, ValueError) as e:
+            raise ManifestError(f"unreadable manifest {path}: {e}") from None
+        if raw.get("version") != MANIFEST_VERSION:
+            raise ManifestError(
+                f"manifest {path} has version {raw.get('version')!r}; "
+                f"this build reads version {MANIFEST_VERSION}"
+            )
+        return Manifest.from_dict(raw)
+
+    def verify_files(self, paths: Sequence[str]) -> None:
+        """Check the resolved on-disk file set against the manifest.
+
+        Raises :class:`ManifestMismatch` with the full per-path diff —
+        missing (manifest names the file, disk doesn't have it), extra
+        (on disk but not in the manifest), and changed (size or sampled
+        content digest differs). This is the loud refusal that keeps a
+        host with a diverged view of the corpus — or a mutated file —
+        from silently shifting every shard's stream.
+        """
+        by_name = {os.path.basename(p): p for p in paths}
+        missing = [fe.name for fe in self.files if fe.name not in by_name]
+        known = {fe.name for fe in self.files}
+        extra = sorted(n for n in by_name if n not in known)
+        changed: List[str] = []
+        for fe in self.files:
+            p = by_name.get(fe.name)
+            if p is None:
+                continue
+            size = os.path.getsize(p)
+            if size != fe.size:
+                changed.append(f"{fe.name} (size {fe.size} -> {size})")
+            elif _sample_digest(p) != fe.sample_sha256:
+                changed.append(f"{fe.name} (content digest changed)")
+        if missing or extra or changed:
+            parts = []
+            if missing:
+                parts.append("missing: " + ", ".join(missing))
+            if extra:
+                parts.append("extra: " + ", ".join(extra))
+            if changed:
+                parts.append("changed: " + ", ".join(changed))
+            raise ManifestMismatch(
+                "file set does not match manifest "
+                f"(fingerprint {self.fingerprint[:12]}): " + "; ".join(parts)
+            )
+
+
+def _scan_file(path: str, require_labels: bool) -> Tuple[FileEntry, Dict[str, Any]]:
+    import h5py
+
+    from roko_tpu.data.hdf5 import data_group_names
+
+    groups: List[Tuple[str, int]] = []
+    geom: Dict[str, Any] = {}
+    with h5py.File(path, "r") as fd:
+        for g in data_group_names(fd):
+            ex = fd[g]["examples"]
+            if require_labels and "labels" not in fd[g]:
+                raise ManifestError(f"{path}:{g} has no labels")
+            groups.append((g, int(ex.shape[0])))
+            row_geom = {
+                "x_shape": tuple(ex.shape[1:]),
+                "x_dtype": str(ex.dtype),
+            }
+            if "labels" in fd[g]:
+                lb = fd[g]["labels"]
+                row_geom["y_shape"] = tuple(lb.shape[1:])
+                row_geom["y_dtype"] = str(lb.dtype)
+            if not geom:
+                geom = row_geom
+            elif geom != row_geom:
+                raise ManifestError(
+                    f"inconsistent row geometry across the file set: "
+                    f"{path}:{g} has {row_geom}, earlier groups {geom}"
+                )
+    entry = FileEntry(
+        name=os.path.basename(path),
+        size=os.path.getsize(path),
+        sha256=_full_digest(path),
+        sample_sha256=_sample_digest(path),
+        groups=tuple(groups),
+    )
+    return entry, geom
+
+
+def build_manifest(
+    spec: Union[str, Sequence[str]],
+    *,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    require_labels: bool = True,
+    log=None,
+) -> Tuple[Manifest, List[str]]:
+    """Scan the resolved file set into a fresh manifest. One full-file
+    hash per file — paid once, the manifest persists."""
+    paths = resolve_file_set(spec)
+    names = [os.path.basename(p) for p in paths]
+    if len(set(names)) != len(names):
+        dup = sorted({n for n in names if names.count(n) > 1})
+        raise ManifestError(
+            "duplicate basenames in the file set (the manifest's "
+            f"cross-host identity is the basename): {', '.join(dup)}"
+        )
+    # every resolved file gets an entry — even one with no data groups
+    # (zero spans): manifest.files[i] must stay aligned with the
+    # resolved path list, and verify_files must not call a known-empty
+    # file "extra" on every later load
+    entries: List[FileEntry] = []
+    geom: Dict[str, Any] = {}
+    for p in paths:
+        entry, g = _scan_file(p, require_labels)
+        entries.append(entry)
+        if not g:
+            continue
+        if not geom:
+            geom = g
+        elif geom != g:
+            raise ManifestError(
+                f"inconsistent row geometry across the file set at {p}: "
+                f"{g} vs {geom}"
+            )
+    if not geom or not any(fe.groups for fe in entries):
+        raise ManifestError(f"no training groups found under {spec!r}")
+    manifest = Manifest(
+        files=tuple(entries),
+        block_size=block_size,
+        labeled="y_dtype" in geom,
+        x_shape=geom["x_shape"],
+        x_dtype=geom["x_dtype"],
+        y_shape=geom.get("y_shape", ()),
+        y_dtype=geom.get("y_dtype", ""),
+    )
+    if log is not None:
+        log(
+            f"datapipe: indexed {len(manifest.files)} file(s), "
+            f"{manifest.total_rows} rows, {len(manifest.spans())} spans "
+            f"(block {manifest.block_size}), "
+            f"fingerprint {manifest.fingerprint[:12]}"
+        )
+    return manifest, paths
+
+
+def default_manifest_path(spec: Union[str, Sequence[str]]) -> Optional[str]:
+    """Where the sidecar manifest lives for a simple spec: inside a
+    directory input, next to a single-file input, nowhere (in-memory
+    only) for list/glob specs unless the caller pins a path."""
+    if isinstance(spec, str):
+        if os.path.isdir(spec):
+            return os.path.join(spec, MANIFEST_BASENAME)
+        if os.path.isfile(spec):
+            return spec + ".manifest.json"
+    return None
+
+
+def load_or_build_manifest(
+    spec: Union[str, Sequence[str]],
+    *,
+    manifest_path: Optional[str] = None,
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    require_labels: bool = True,
+    log=None,
+) -> Tuple[Manifest, List[str]]:
+    """Load a persisted manifest if one matches the files, else build
+    (and persist, best-effort) a fresh one.
+
+    An explicitly pinned ``manifest_path`` that mismatches the on-disk
+    files REFUSES with the path diff (the caller asserted a corpus
+    identity); the default sidecar merely logs loudly and rebuilds (a
+    regenerated corpus is a legitimate state, not an error).
+    """
+    pinned = manifest_path is not None
+    mpath = manifest_path or default_manifest_path(spec)
+    paths = resolve_file_set(spec)
+    if mpath and os.path.exists(mpath):
+        try:
+            # ManifestError covers unreadable/corrupt/version-mismatch
+            # sidecars as well as a file-set mismatch — for the DEFAULT
+            # sidecar all of them mean "rebuild the index loudly", not
+            # "refuse a file the user never created"; only a PINNED
+            # manifest is an identity assertion worth refusing over
+            manifest = Manifest.load(mpath)
+            manifest.verify_files(paths)
+        except ManifestError as e:
+            if pinned:
+                raise
+            if log is not None:
+                log(
+                    f"datapipe: manifest {mpath} is stale or unreadable "
+                    f"for the file set on disk ({e}); rebuilding the index"
+                )
+        else:
+            if manifest.block_size != block_size:
+                manifest = dataclasses.replace(manifest, block_size=block_size)
+            return manifest, paths
+    manifest, paths = build_manifest(
+        paths, block_size=block_size, require_labels=require_labels, log=log
+    )
+    if mpath:
+        try:
+            manifest.save(mpath)
+        except OSError as e:  # read-only corpus dir: index stays in RAM
+            if log is not None:
+                log(f"datapipe: could not persist manifest at {mpath}: {e}")
+    return manifest, paths
+
+
+def crosscheck_fingerprint(manifest: Manifest, log=None) -> None:
+    """Multi-host agreement check: every process must have computed the
+    same corpus fingerprint, or shard assignment is undefined. Gathers
+    the 64-bit fingerprint prefix over jax's coordination service and
+    refuses loudly (with this host's file list in the message) on any
+    divergence. No-op single-process."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    hi, lo = manifest.fingerprint32_pair()
+    mine = np.asarray([hi, lo, len(manifest.files), manifest.total_rows], np.int64)
+    allv = np.asarray(multihost_utils.process_allgather(mine))
+    bad = [i for i in range(allv.shape[0]) if not np.array_equal(allv[i], mine)]
+    if bad:
+        names = ", ".join(fe.name for fe in manifest.files)
+        raise ManifestMismatch(
+            f"hosts disagree on the training file set: process "
+            f"{jax.process_index()} fingerprint {manifest.fingerprint[:12]} "
+            f"({len(manifest.files)} files, {manifest.total_rows} rows: "
+            f"{names}) differs from process(es) {bad}. Every host must "
+            "see the identical corpus — sync the files or pin a shared "
+            "manifest with --data-manifest, then compare each host's "
+            "refusal line to see the per-host diff."
+        )

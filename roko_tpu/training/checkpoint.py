@@ -237,8 +237,13 @@ class CheckpointManager:
             out.append((int(step), self._step_path(int(step))))
         return out
 
-    def _keys_at(self, name: Union[str, int]) -> Optional[set]:
-        """Top-level key names of one candidate checkpoint."""
+    def _tree_at(self, name: Union[str, int]):
+        """One candidate checkpoint's on-disk key STRUCTURE — a nested
+        mapping (leaf values are metadata/arrays, only the keys
+        matter). Used to filter a restore template per candidate, at
+        every nesting level: newer layouts add nested bookkeeping (e.g.
+        ``data_state.pipe``), and a target naming keys a candidate
+        doesn't have makes orbax refuse the whole restore."""
         if name == "latest":
             self._ckptr.wait_until_finished()
             meta = self._ckptr.metadata(self._latest_path)
@@ -257,11 +262,31 @@ class CheckpointManager:
             # SAVED in this process has no metadata handler for the
             # step's "default" item and returns an empty wrapper (the
             # metadata analogue of the targetless-restore KeyError).
-            # Fall back to a targetless restore purely for the key set
-            # — only the fallback-to-numbered-step path pays the extra
-            # read, and only on a fresh process.
-            return set(self._restore_at(name, None).keys())
-        return set(tree.keys())
+            # Fall back to a targetless restore purely for the key
+            # structure — only the fallback-to-numbered-step path pays
+            # the extra read, and only on a fresh process.
+            return self._restore_at(name, None)
+        return tree
+
+    @staticmethod
+    def _filter_template(template, tree):
+        """Recursively drop template keys the candidate doesn't have
+        (at ANY depth), so older checkpoint layouts restore without
+        guessing — the nested analogue of the top-level key filtering
+        ADVICE r1 (a) introduced."""
+        out = {}
+        for k, v in template.items():
+            try:
+                sub = tree[k]
+            except (KeyError, TypeError):
+                continue
+            if isinstance(v, dict):
+                out[k] = CheckpointManager._filter_template(
+                    v, sub if hasattr(sub, "__getitem__") else {}
+                )
+            else:
+                out[k] = v
+        return out
 
     def _restore_at(self, name: Union[str, int], like):
         if name == "latest":
@@ -316,8 +341,9 @@ class CheckpointManager:
                 continue
             cand_like = like
             if template is not None:
-                keys = self._keys_at(name)
-                cand_like = {k: v for k, v in template.items() if k in keys}
+                cand_like = self._filter_template(
+                    template, self._tree_at(name)
+                )
             try:
                 return self._restore_at(name, cand_like)
             except Exception as e:  # restore blew up on a "verified" dir
@@ -363,7 +389,7 @@ class CheckpointManager:
         cands = self._candidates()
         if not cands:
             return None
-        return self._keys_at(cands[0][0])
+        return set(self._tree_at(cands[0][0]).keys())
 
     def has_checkpoint(self) -> bool:
         return bool(self._candidates())

@@ -76,33 +76,39 @@ class InMemoryDataset:
         without biasing metrics).
 
         ``skip_batches`` fast-forwards past the first k batches of the
-        SAME epoch stream (the full permutation is still drawn, so the
-        remaining batches are bit-identical to positions k.. of an
-        unskipped iteration) — step-granular resume replays an
-        interrupted epoch from exactly the next untrained batch
-        (docs/TRAINING.md).
+        SAME epoch stream — step-granular resume replays an interrupted
+        epoch from exactly the next untrained batch (docs/TRAINING.md).
+
+        Delegates to the sharded input engine
+        (``roko_tpu/datapipe/engine.py``) over in-RAM spans cut at the
+        datapipe block size: block permutation + per-block row
+        permutations, so the epoch stream semantics match the
+        manifest-backed :class:`roko_tpu.datapipe.ShardedDataset`,
+        fast-forward is index arithmetic, and at most ~a block of
+        fancy-indexed rows is materialised at a time (a corpus-sized
+        ``X[order]`` copy would double peak host RAM for the multi-GB
+        flagship corpus this class exists for).
         """
+        from roko_tpu.datapipe.engine import iter_span_batches
+        from roko_tpu.datapipe.manifest import DEFAULT_BLOCK_SIZE
+
         n = len(self)
-        order = rng.permutation(n) if rng is not None else np.arange(n)
-        for start in range(skip_batches * batch_size, n, batch_size):
-            idx = order[start : start + batch_size]
-            if len(idx) < batch_size:
-                if drop_remainder:
-                    return
-                if pad_to is not None:
-                    x = self.X[idx]
-                    y = self.Y[idx]
-                    w = np.ones(len(idx), np.float32)
-                    pad = pad_to - len(idx)
-                    if pad > 0:
-                        x = np.concatenate([x, np.zeros((pad,) + x.shape[1:], x.dtype)])
-                        y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
-                        w = np.concatenate([w, np.zeros(pad, np.float32)])
-                    yield x, y, w
-                    return
-            x = self.X[idx]
-            y = self.Y[idx]
-            yield x, y, np.ones(len(idx), np.float32)
+        starts = list(range(0, n, DEFAULT_BLOCK_SIZE))
+        counts = [min(DEFAULT_BLOCK_SIZE, n - s) for s in starts]
+
+        def read_rows(b: int, order: np.ndarray):
+            sel = starts[b] + order
+            return self.X[sel], self.Y[sel]
+
+        yield from iter_span_batches(
+            counts,
+            read_rows,
+            batch_size,
+            rng=rng,
+            drop_remainder=drop_remainder,
+            pad_to=pad_to,
+            skip_batches=skip_batches,
+        )
 
 
 def prefetch_to_device(iterator, size: int, place) -> Iterator:

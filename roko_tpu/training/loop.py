@@ -36,7 +36,7 @@ from roko_tpu.parallel.mesh import (
     replicated_sharding,
 )
 from roko_tpu.training import checkpoint as ckpt_lib
-from roko_tpu.training.data import InMemoryDataset, prefetch_to_device
+from roko_tpu.training.data import prefetch_to_device
 from roko_tpu.utils.profiling import device_trace
 
 Params = Dict[str, Any]
@@ -208,19 +208,24 @@ def make_eval_step(model: RokoModel, mesh: Mesh) -> Callable:
     return step
 
 
-def make_placer(mesh: Mesh) -> Callable:
-    """Host->device placement for a (x, y, w)-style tuple of global
-    batches, correct on multi-host pods.
+def make_placer(mesh: Mesh, *, local_rows: bool = False) -> Callable:
+    """Host->device placement for a (x, y, w)-style tuple of batches,
+    correct on multi-host pods.
 
     Single process: a plain ``device_put`` onto the dp sharding. With
     ``jax.process_count() > 1`` a host cannot ``device_put`` onto a mesh
-    spanning non-addressable devices; instead every process slices its
-    own rows out of the (identically generated) global batch and wraps
-    them with ``jax.make_array_from_process_local_data``, which
+    spanning non-addressable devices; instead every process wraps its
+    own rows with ``jax.make_array_from_process_local_data``, which
     assembles the logically-global array from per-process shards
     (SURVEY.md §5.8; VERDICT r2 task #3). Row-slice <-> device locality
     holds because ``jax.devices()`` orders devices process-major and the
-    mesh's dp axis follows that order."""
+    mesh's dp axis follows that order.
+
+    ``local_rows=False`` (the legacy contract): every process generated
+    the identical GLOBAL batch and slices out its rows here.
+    ``local_rows=True`` (the sharded data plane): each process feeds
+    only its own shard's rows — the global batch is their process-order
+    concatenation, and no host ever generated rows it doesn't own."""
     sharding = data_sharding(mesh)
     nproc = jax.process_count()
     pid = jax.process_index()
@@ -230,16 +235,21 @@ def make_placer(mesh: Mesh) -> Callable:
             return tuple(jax.device_put(a, sharding) for a in batch)
         out = []
         for a in batch:
-            if a.shape[0] % nproc:
-                raise ValueError(
-                    f"global batch {a.shape[0]} not divisible by "
-                    f"{nproc} processes"
-                )
-            per = a.shape[0] // nproc
-            local = a[pid * per : (pid + 1) * per]
+            if local_rows:
+                local = a
+                global_shape = (a.shape[0] * nproc,) + a.shape[1:]
+            else:
+                if a.shape[0] % nproc:
+                    raise ValueError(
+                        f"global batch {a.shape[0]} not divisible by "
+                        f"{nproc} processes"
+                    )
+                per = a.shape[0] // nproc
+                local = a[pid * per : (pid + 1) * per]
+                global_shape = a.shape
             out.append(
                 jax.make_array_from_process_local_data(
-                    sharding, local, a.shape
+                    sharding, local, global_shape
                 )
             )
         return tuple(out)
@@ -331,6 +341,7 @@ def train(
         log = lambda s: None  # noqa: E731 — primary-only logging
     tcfg = cfg.train
     gcfg = cfg.guard
+    dcfg = cfg.data
     mesh = mesh or make_mesh(cfg.mesh)
     dp = mesh.shape[AXIS_DP]
     if tcfg.batch_size % dp:
@@ -339,20 +350,89 @@ def train(
         )
     _warn_if_cpu_mesh_oversubscribed(mesh, log)
 
-    if tcfg.in_memory:
-        train_ds = InMemoryDataset.from_path(train_path)
-    else:  # out-of-core streaming (ref lazy TrainDataset, SURVEY §2.7)
-        from roko_tpu.training.lazy_data import StreamingDataset
+    # -- sharded input data plane (roko_tpu/datapipe, docs/TRAINING.md
+    # "Sharded input pipeline"): resolve the shard spec, index the file
+    # set, and stream only this host's span blocks
+    from roko_tpu.datapipe import ShardedDataset
+    from roko_tpu.datapipe.manifest import crosscheck_fingerprint
 
-        train_ds = StreamingDataset(train_path)
-    val_ds = InMemoryDataset.from_path(val_path) if val_path else None
-    if val_ds is None and tcfg.val_fraction > 0:
-        if not tcfg.in_memory:
+    nproc = jax.process_count()
+    shards = dcfg.shards if dcfg.shards > 0 else max(1, nproc)
+    shard_id = dcfg.shard_id if dcfg.shard_id >= 0 else jax.process_index()
+    data_seed = dcfg.seed if dcfg.seed >= 0 else tcfg.seed
+    if nproc > 1:
+        # on a pod the shard topology IS the process topology: each
+        # host feeds its own rows and the global batch is their
+        # process-order concatenation (make_placer local_rows)
+        if shards != nproc:
             raise ValueError(
-                "--val-fraction needs the in-memory dataset (--memory); "
-                "pass an explicit --val set for streaming runs"
+                f"--data-shards {shards} on a {nproc}-process pod: "
+                "shards must equal the process count (one shard per host)"
             )
-        train_ds, val_ds = train_ds.split_holdout(tcfg.val_fraction, tcfg.seed)
+        if shard_id != jax.process_index():
+            raise ValueError(
+                f"--data-shard-id {shard_id} conflicts with "
+                f"jax.process_index()={jax.process_index()} on a pod; "
+                "leave it at -1 (auto)"
+            )
+    if tcfg.batch_size % shards:
+        raise ValueError(
+            f"batch_size {tcfg.batch_size} not divisible by "
+            f"{shards} data shards"
+        )
+    local_bs = tcfg.batch_size // shards
+    model_batch = local_bs * (nproc if nproc > 1 else 1)
+    if model_batch % dp:
+        raise ValueError(
+            f"per-step device batch {model_batch} (batch_size "
+            f"{tcfg.batch_size} / {shards} shards) not divisible by dp={dp}"
+        )
+
+    train_ds = ShardedDataset(
+        train_path,
+        num_shards=shards,
+        shard_id=shard_id,
+        seed=data_seed,
+        block_size=dcfg.block_size,
+        prefetch_blocks=dcfg.input_prefetch,
+        mix_blocks=dcfg.mix_blocks,
+        preload=tcfg.in_memory,
+        manifest_path=dcfg.manifest,
+        log=log,
+    )
+    crosscheck_fingerprint(train_ds.manifest)  # no-op single process
+    if shards > 1 and train_ds.num_blocks < 4 * shards:
+        log(
+            f"WARNING: only {train_ds.num_blocks} span block(s) for "
+            f"{shards} data shards — shard balance is block-granular; "
+            "lower --data-block-size (or grow the corpus) so every "
+            "shard owns several blocks"
+        )
+    val_ds = (
+        ShardedDataset(
+            val_path,
+            seed=data_seed,
+            block_size=dcfg.block_size,
+            prefetch_blocks=dcfg.input_prefetch,
+            preload=tcfg.in_memory,
+        )
+        if val_path
+        else None
+    )
+    if val_ds is not None:
+        # hosts disagreeing on the VAL corpus would compute different
+        # val_acc and take different early-stop/guard branches —
+        # a pod deadlock, not a metric blip; refuse like the train path
+        crosscheck_fingerprint(val_ds.manifest)
+    holdout_ppm = 0
+    if val_ds is None and tcfg.val_fraction > 0:
+        # row-level seeded holdout, identical on every host; works for
+        # both the preloaded and streaming backends (the split is index
+        # arithmetic over the manifest, not a data copy). The fraction
+        # shapes the train stream, so it is pinned in data_state.pipe
+        # (parts-per-million — the pipe tree is int32).
+        holdout_ppm = int(round(tcfg.val_fraction * 1e6))
+        train_ds, val_ds = train_ds.split_holdout(tcfg.val_fraction, data_seed)
         log(
             f"held out {len(val_ds)} of {len(train_ds) + len(val_ds)} "
             "windows for validation (--val-fraction)"
@@ -360,6 +440,12 @@ def train(
     log(
         f"train windows: {len(train_ds)}"
         + (f", val windows: {len(val_ds)}" if val_ds else " (no val set)")
+        + (
+            f" [shard {shard_id}/{shards}: {train_ds.local_rows()} local "
+            f"rows, corpus {train_ds.manifest.fingerprint[:12]}]"
+            if shards > 1
+            else ""
+        )
     )
 
     model = RokoModel(cfg.model)
@@ -374,8 +460,10 @@ def train(
         )
 
     eval_step = make_eval_step(model, mesh)
-    place = make_placer(mesh)
-    steps_per_epoch = max(1, -(-len(train_ds) // tcfg.batch_size))
+    # the train stream feeds LOCAL shard rows (each host its own); the
+    # eval path keeps the legacy identical-global-batch contract
+    place = make_placer(mesh, local_rows=shards > 1)
+    steps_per_epoch = max(1, train_ds.steps_per_epoch(local_bs))
 
     manager = ckpt_lib.CheckpointManager(
         out_dir, keep=tcfg.keep_checkpoints, log=log
@@ -439,6 +527,21 @@ def train(
                     "consecutive_bad": jnp.zeros((), jnp.int32),
                     "rollbacks": jnp.zeros((), jnp.int32),
                 },
+                # shard topology + corpus fingerprint the run was
+                # trained on: a resume under a different sharding or a
+                # mutated corpus would silently shift every stream, so
+                # it refuses instead (datapipe manifest)
+                "pipe": {
+                    "shards": jnp.zeros((), jnp.int32),
+                    "shard_id": jnp.zeros((), jnp.int32),
+                    "seed": jnp.zeros((), jnp.int32),
+                    "block_size": jnp.zeros((), jnp.int32),
+                    "mix": jnp.zeros((), jnp.int32),
+                    "local_bs": jnp.zeros((), jnp.int32),
+                    "val_ppm": jnp.zeros((), jnp.int32),
+                    "fp_hi": jnp.zeros((), jnp.int32),
+                    "fp_lo": jnp.zeros((), jnp.int32),
+                },
             },
         )
         if resume or attempt > 0:
@@ -463,6 +566,76 @@ def train(
                         persisted_rollbacks = int(gstate["rollbacks"])
                         if guard is not None:
                             guard.load_state(gstate)
+                    pstate = dstate.get("pipe")
+                    if pstate is not None:
+                        # refuse any change to the inputs the epoch
+                        # stream is a pure function of: (fingerprint,
+                        # shards, shard_id, seed, block_size, mix).
+                        # shard_id is pinned only single-process: on a
+                        # pod it EQUALS process_index (validated above)
+                        # but differs per host, and the checkpoint's
+                        # scalar bookkeeping is a replicated tree —
+                        # persisting a per-host value there would make
+                        # every non-primary host refuse its own resume.
+                        fp_hi, fp_lo = train_ds.manifest.fingerprint32_pair()
+                        keys = (
+                            "shards", "shard_id", "seed", "block_size",
+                            "mix", "local_bs", "val_ppm", "fp_hi", "fp_lo",
+                        )
+                        # the persisted position is denominated in
+                        # LOCAL batches, so local_bs is pinned only for
+                        # a MID-epoch resume (start_batch > 0) — a
+                        # different batch size would land at the wrong
+                        # sample. At an epoch boundary the position is
+                        # 0 in any unit, and resuming with a new batch
+                        # size is a supported, test-pinned workflow.
+                        skip = (
+                            frozenset() if start_batch > 0
+                            else frozenset(("local_bs",))
+                        )
+                        cmp_keys = [
+                            k for k in keys if k in pstate and k not in skip
+                        ]
+                        saved = tuple(int(pstate[k]) for k in cmp_keys)
+                        now_all = dict(
+                            shards=shards,
+                            shard_id=shard_id if nproc == 1 else -1,
+                            seed=data_seed,
+                            block_size=dcfg.block_size,
+                            mix=dcfg.mix_blocks,
+                            local_bs=local_bs,
+                            val_ppm=holdout_ppm,
+                            fp_hi=fp_hi, fp_lo=fp_lo,
+                        )
+                        now = tuple(now_all[k] for k in cmp_keys)
+                        if saved != now:
+                            diff = ", ".join(
+                                f"{k}: {s} -> {n}"
+                                for k, s, n in zip(cmp_keys, saved, now)
+                                if s != n
+                            )
+                            raise RuntimeError(
+                                "refusing to resume: the data-stream "
+                                f"spec changed since the checkpoint ({diff}"
+                                "; fp = corpus fingerprint). The stream "
+                                "would silently diverge from the trained "
+                                "prefix — restore the original sharding/"
+                                "seed/corpus or start fresh with "
+                                "--no-resume."
+                            )
+                    elif start_batch > 0:
+                        # pre-datapipe mid-epoch checkpoint: the epoch
+                        # stream algorithm changed in this release, so
+                        # the rest of THIS epoch rides a different
+                        # shuffle than its trained prefix (coverage of
+                        # later epochs is unaffected)
+                        log(
+                            "ROKO_GUARD event=legacy_resume "
+                            "detail=pre-datapipe mid-epoch checkpoint; "
+                            "the remainder of the current epoch replays "
+                            "on the new input-pipeline shuffle, not the "
+                            "one its prefix trained on"
+                        )
                 elif "epoch" in restored:
                     start_epoch = int(jax.device_get(restored["epoch"])) + 1
                 else:  # pre-'epoch' layout: recover from the step count
@@ -510,6 +683,26 @@ def train(
                 "rollbacks": np.asarray(jitter, np.int32),
             }
 
+        def _pipe_state():
+            # rides the REPLICATED scalar tree: every field must be
+            # identical on all pod processes, so the per-host shard_id
+            # is pinned only single-process (-1 = derived from
+            # process_index, nothing to pin)
+            fp_hi, fp_lo = train_ds.manifest.fingerprint32_pair()
+            return {
+                "shards": np.asarray(shards, np.int32),
+                "shard_id": np.asarray(
+                    shard_id if jax.process_count() == 1 else -1, np.int32
+                ),
+                "seed": np.asarray(data_seed, np.int32),
+                "block_size": np.asarray(dcfg.block_size, np.int32),
+                "mix": np.asarray(dcfg.mix_blocks, np.int32),
+                "local_bs": np.asarray(local_bs, np.int32),
+                "val_ppm": np.asarray(holdout_ppm, np.int32),
+                "fp_hi": np.asarray(fp_hi, np.int32),
+                "fp_lo": np.asarray(fp_lo, np.int32),
+            }
+
         def _save_mid(epoch, n_batches, n_applied, running):
             # mid-epoch, latest-only checkpoint carrying the data
             # position; scalar bookkeeping must be globally-replicated
@@ -531,6 +724,7 @@ def train(
                             jax.device_get(running), np.float32
                         ),
                         "guard": _guard_state(),
+                        "pipe": _pipe_state(),
                     },
                 },
                 mesh,
@@ -542,21 +736,20 @@ def train(
         for epoch in range(start_epoch, tcfg.epochs):
             t0 = time.perf_counter()
             skip = start_batch if epoch == start_epoch else 0
-            # per-epoch derived RNG: epoch E shuffles identically whether
-            # or not the run was interrupted before (or inside) it, for
-            # both the in-memory and streaming datasets; a mid-epoch
-            # resume fast-forwards the SAME stream to batch `skip`
-            np_rng = np.random.default_rng(
-                np.random.SeedSequence([tcfg.seed, epoch])
-            )
-            # pad the trailing batch (zero-weight rows) instead of dropping
-            # it: fixed shapes for XLA, but every window trains (the
-            # reference's DataLoader also kept the last partial batch)
-            batches = train_ds.batches(
-                tcfg.batch_size,
-                rng=np_rng,
-                pad_to=tcfg.batch_size,
-                skip_batches=skip,
+            # sample-granular checkpointable iterator over this shard's
+            # slice of the epoch stream: epoch E shuffles identically
+            # whether or not the run was interrupted inside it (the
+            # stream rng derives from (data seed, epoch) in
+            # ShardedDataset.epoch_rng), and a mid-epoch resume
+            # fast-forwards to batch `skip` in O(spans skipped) — no
+            # prefix re-read. The trailing batch pads (zero-weight
+            # rows) instead of dropping: fixed shapes for XLA, but
+            # every window trains.
+            batches = train_ds.iterator(
+                epoch,
+                local_bs,
+                pad_to=local_bs,
+                start_batch=skip,
             )
             # loss accumulates on device in f32 (one chain of adds in
             # batch order — the property the bit-identical resumed loss
@@ -613,7 +806,7 @@ def train(
                         eta = (steps_per_epoch - n_batches) / max(rate, 1e-9)
                         log(
                             f"  epoch {epoch} step {n_batches}/{steps_per_epoch} "
-                            f"({rate * tcfg.batch_size:.0f} windows/s, "
+                            f"({rate * model_batch:.0f} windows/s, "
                             f"eta {eta:.0f}s)"
                         )
                     # (the epoch's final batch skips the mid save — the
@@ -628,7 +821,10 @@ def train(
                 running_h = float(jax.device_get(running))
             dt = time.perf_counter() - t0
 
-            eval_ds = val_ds if val_ds is not None else train_ds
+            # no-val fallback evaluates the FULL train corpus (an
+            # unsharded view): every host must compute the identical
+            # metric or early-stop/guard decisions would diverge
+            eval_ds = val_ds if val_ds is not None else train_ds.unsharded()
             acc, vloss = evaluate(eval_step, params, eval_ds, tcfg.batch_size, mesh)
             guard_note = (
                 f" [{guard.summary()}]"
@@ -639,7 +835,7 @@ def train(
                 f"epoch {epoch}: train_loss {running_h / max(n_applied,1):.4f} "
                 f"val_acc {acc:.5f} val_loss {vloss:.4f} "
                 f"({dt:.1f}s, {n_batches} steps, "
-                f"{(n_batches - skip) * tcfg.batch_size / max(dt, 1e-9):.0f} "
+                f"{(n_batches - skip) * model_batch / max(dt, 1e-9):.0f} "
                 f"windows/s)" + guard_note
             )
 
@@ -669,6 +865,7 @@ def train(
                         "applied": np.asarray(0, np.int32),
                         "loss_sum": np.asarray(0.0, np.float32),
                         "guard": _guard_state(),
+                        "pipe": _pipe_state(),
                     },
                 },
                 mesh,

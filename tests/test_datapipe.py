@@ -1,0 +1,818 @@
+"""Deterministic sharded input data plane (roko_tpu/datapipe): manifest
+index layer, shard/shuffle engine, checkpointable iterators, and the
+training-loop integration (docs/TRAINING.md "Sharded input pipeline").
+
+The acceptance contracts pinned here:
+
+- for num_shards in {1,2,4} with a fixed seed, the per-shard streams
+  PARTITION the 1-shard stream exactly (disjoint, union-complete, each
+  a subsequence of the global order), stable across runs;
+- an interrupted-and-resumed 2-shard run is bit-identical (params AND
+  loss curve) to an uninterrupted one (real-SIGKILL variant:
+  tests/test_fault_injection.py::test_sigkill_mid_epoch_sharded_resume);
+- global shuffle never materialises the corpus: the read-accounting
+  hook on the index reader bounds resident rows to a few blocks;
+- a mutated corpus / diverged file set refuses loudly with the diff.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    DataConfig,
+    GuardConfig,
+    MeshConfig,
+    ModelConfig,
+    RokoConfig,
+    TrainConfig,
+)
+from roko_tpu.data.hdf5 import DataWriter, hdf5_files
+from roko_tpu.datapipe import (
+    CheckpointableIterator,
+    Manifest,
+    ManifestMismatch,
+    ReadStats,
+    ShardedDataset,
+    build_manifest,
+    load_or_build_manifest,
+    resolve_file_set,
+)
+from roko_tpu.training.loop import train
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+def _write_file(path, rng, n, tag, rows=4, cols=6):
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, rows, cols)).astype(np.uint8)
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    pos = [np.stack([np.arange(cols), np.zeros(cols)], 1)] * n
+    with DataWriter(str(path), infer=False) as w:
+        w.write_contigs([(tag, "ACGT" * 10)])
+        w.store(tag, pos, list(X), list(Y))
+    return X, Y
+
+
+def _corpus(tmp_path, rng, sizes=(40, 56, 24)):
+    d = tmp_path / "corpus"
+    d.mkdir(exist_ok=True)
+    for i, n in enumerate(sizes):
+        _write_file(d / f"part{i}.hdf5", rng, n, f"c{i}")
+    return str(d)
+
+
+def _rows(ds, epoch, bs, **kw):
+    """Real (non-padding) rows of one epoch stream, as bytes keys."""
+    out = []
+    for x, _y, w in ds.batches(
+        bs, rng=ds.epoch_rng(epoch), pad_to=bs, **kw
+    ):
+        out.extend(r.tobytes() for r in x[: int(w.sum())])
+    return out
+
+
+# -- file-set resolution (satellite: stable across hosts) ---------------
+
+
+def test_hdf5_files_sorts_by_basename_and_dedupes_symlinks(tmp_path, rng):
+    d = tmp_path / "d"
+    d.mkdir()
+    for name in ("b.hdf5", "a.hdf5", "c.h5"):
+        _write_file(d / name, rng, 8, name.split(".")[0])
+    os.symlink(d / "a.hdf5", d / "zz-alias.hdf5")  # symlinked duplicate
+    (d / "notes.txt").write_text("ignored")
+    files = hdf5_files(str(d))
+    assert [os.path.basename(f) for f in files] == ["a.hdf5", "b.hdf5", "c.h5"]
+
+
+def test_resolve_file_set_globs_lists_and_errors(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    assert len(resolve_file_set(d)) == 3
+    assert len(resolve_file_set([os.path.join(d, "part*.hdf5")])) == 3
+    mixed = resolve_file_set([os.path.join(d, "part1.hdf5"), d])
+    assert [os.path.basename(p) for p in mixed] == [
+        "part0.hdf5", "part1.hdf5", "part2.hdf5",
+    ]  # deduped by inode, basename-sorted
+    with pytest.raises(Exception, match="no HDF5 inputs"):
+        resolve_file_set(os.path.join(d, "nope*.hdf5"))
+
+
+# -- manifest index layer -----------------------------------------------
+
+
+def test_manifest_roundtrip_and_fingerprint_stable(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    m1, paths = build_manifest(d, block_size=16)
+    m1.save(str(tmp_path / "m.json"))
+    m2 = Manifest.load(str(tmp_path / "m.json"))
+    assert m2 == m1
+    assert m2.fingerprint == m1.fingerprint
+    assert m1.total_rows == 120
+    assert len(m1.spans()) == sum(-(-n // 16) for n in (40, 56, 24))
+    # fingerprint is content identity: a fresh scan agrees
+    m3, _ = build_manifest(d, block_size=32)
+    assert m3.fingerprint == m1.fingerprint  # block size is not identity
+    hi, lo = m1.fingerprint32_pair()
+    assert np.int32(hi) == hi and np.int32(lo) == lo
+
+
+def test_pinned_manifest_refuses_mutated_file(tmp_path, rng):
+    """Acceptance satellite: manifest fingerprint refusal on a mutated
+    file — a pinned manifest that no longer matches the bytes on disk
+    refuses loudly, naming the culprit."""
+    d = _corpus(tmp_path, rng)
+    m, _ = build_manifest(d)
+    mpath = str(tmp_path / "pinned.json")
+    m.save(mpath)
+    # pinned + intact: loads fine
+    ShardedDataset(d, manifest_path=mpath)
+    victim = os.path.join(d, "part1.hdf5")
+    with open(victim, "r+b") as f:
+        f.seek(os.path.getsize(victim) // 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(ManifestMismatch, match="part1.hdf5"):
+        ShardedDataset(d, manifest_path=mpath)
+
+
+def test_manifest_diff_names_missing_and_extra(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    m, _ = build_manifest(d)
+    os.unlink(os.path.join(d, "part0.hdf5"))
+    _write_file(tmp_path / "corpus" / "part9.hdf5", rng, 8, "c9")
+    with pytest.raises(ManifestMismatch) as ei:
+        m.verify_files(resolve_file_set(d))
+    msg = str(ei.value)
+    assert "missing: part0.hdf5" in msg and "extra: part9.hdf5" in msg
+
+
+def test_stale_sidecar_manifest_rebuilds_loudly(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    logs = []
+    m1, _ = load_or_build_manifest(d, log=logs.append)
+    assert os.path.exists(os.path.join(d, "roko_datapipe_manifest.json"))
+    # regenerate a file in place (legitimate re-extraction)
+    _write_file(tmp_path / "corpus" / "part2.hdf5", rng, 30, "c2new")
+    logs2 = []
+    m2, _ = load_or_build_manifest(d, log=logs2.append)
+    assert m2.fingerprint != m1.fingerprint
+    assert any("stale" in l for l in logs2)
+    # the rebuilt sidecar now verifies clean
+    m3, _ = load_or_build_manifest(d, log=logs2.append)
+    assert m3.fingerprint == m2.fingerprint
+    # a CORRUPT default sidecar (unreadable JSON) also rebuilds rather
+    # than hard-blocking training on a file the user never created
+    sidecar = os.path.join(d, "roko_datapipe_manifest.json")
+    with open(sidecar, "w") as f:
+        f.write("{not json")
+    logs3 = []
+    m4, _ = load_or_build_manifest(d, log=logs3.append)
+    assert m4.fingerprint == m2.fingerprint
+    assert any("unreadable" in l for l in logs3)
+    # but a PINNED corrupt manifest refuses (identity assertion)
+    with open(sidecar, "w") as f:
+        f.write("{not json")
+    with pytest.raises(Exception, match="unreadable manifest"):
+        load_or_build_manifest(d, manifest_path=sidecar)
+
+
+# -- shard/shuffle determinism (acceptance) ------------------------------
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 4])
+def test_shard_union_partitions_global_stream(tmp_path, rng, num_shards):
+    d = _corpus(tmp_path, rng)
+    glob = _rows(ShardedDataset(d, seed=11, block_size=16), 2, 8)
+    assert len(glob) == 120
+    shard_rows = [
+        _rows(
+            ShardedDataset(
+                d, seed=11, block_size=16,
+                num_shards=num_shards, shard_id=s,
+            ),
+            2, 8, equalize=False,
+        )
+        for s in range(num_shards)
+    ]
+    # union is exactly the 1-shard stream (as a multiset: there is no
+    # canonical interleave order for N concurrently-consumed streams,
+    # and each shard cross-mixes its own blocks for within-batch
+    # diversity)...
+    union = sum(shard_rows, [])
+    assert sorted(union) == sorted(glob)
+    # ...and disjoint across shards
+    assert len(set(union)) == len(union)
+    # order-stable across runs (fresh dataset objects, same seed)
+    again = [
+        _rows(
+            ShardedDataset(
+                d, seed=11, block_size=16,
+                num_shards=num_shards, shard_id=s,
+            ),
+            2, 8, equalize=False,
+        )
+        for s in range(num_shards)
+    ]
+    assert again == shard_rows
+
+
+def test_epochs_shuffle_differently_but_deterministically(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    ds = ShardedDataset(d, seed=5, block_size=16)
+    e0, e1 = _rows(ds, 0, 8), _rows(ds, 1, 8)
+    assert sorted(e0) == sorted(e1) and e0 != e1
+    assert _rows(ShardedDataset(d, seed=5, block_size=16), 0, 8) == e0
+
+
+def test_mix_groups_diversify_batches_across_blocks(tmp_path, rng):
+    """A batch must mix rows from multiple span blocks (HDF5 corpora
+    are locality-ordered, so block-atomic batches would be correlated):
+    with mix_blocks=4 every full batch draws from >1 source block,
+    where mix_blocks=1 keeps each batch inside a single block."""
+    d = tmp_path / "one"
+    d.mkdir()
+    X, _ = _write_file(d / "a.hdf5", rng, 64, "a")
+    block_of = {X[i].tobytes(): i // 16 for i in range(64)}
+
+    def batch_blocks(mix):
+        ds = ShardedDataset(str(d), seed=1, block_size=16, mix_blocks=mix)
+        return [
+            {block_of[r.tobytes()] for r in x}
+            for x, _y, w in ds.batches(16, rng=ds.epoch_rng(0))
+        ]
+
+    assert all(len(bs) == 1 for bs in batch_blocks(1))
+    assert all(len(bs) > 1 for bs in batch_blocks(4))
+
+
+def test_preload_and_stream_bit_identical(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    a = _rows(ShardedDataset(d, seed=3, block_size=16, preload=True), 0, 8)
+    b = _rows(ShardedDataset(d, seed=3, block_size=16), 0, 8)
+    assert a == b
+
+
+def test_global_shuffle_never_materializes_corpus(tmp_path, rng):
+    """Acceptance: the read-accounting hook proves a full shuffled epoch
+    holds at most a few blocks of rows, while reading every row exactly
+    once."""
+    d = _corpus(tmp_path, rng, sizes=(64, 64, 64, 48))
+    ds = ShardedDataset(
+        d, seed=1, block_size=16, prefetch_blocks=1, mix_blocks=2
+    )
+    stats = ReadStats()
+    n = sum(
+        int(w.sum())
+        for _x, _y, w in ds.batches(
+            8, rng=ds.epoch_rng(0), pad_to=8, stats=stats
+        )
+    )
+    assert n == 240 and stats.rows_read == 240  # every row exactly once
+    # resident high-water (read-but-not-yet-emitted rows, INCLUDING the
+    # prefetch queue): ~(prefetch+2) mix groups, nowhere near the corpus
+    assert stats.max_resident_rows <= 7 * 16 < 240
+
+
+def test_skip_batches_fast_forward_reads_only_remaining(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    ds = ShardedDataset(d, seed=2, block_size=16, mix_blocks=2)
+    full = _rows(ds, 0, 8)
+    stats = ReadStats()
+    skipped = []
+    for x, _y, w in ds.batches(
+        8, rng=ds.epoch_rng(0), pad_to=8, skip_batches=10, stats=stats
+    ):
+        skipped.extend(r.tobytes() for r in x[: int(w.sum())])
+    assert skipped == full[80:]  # bit-identical tail
+    # O(spans skipped): only the mix groups overlapping the tail were
+    # read — never the skipped prefix
+    assert stats.rows_read <= (120 - 80) + 2 * 16
+
+
+def test_checkpointable_iterator_state_restore_sample_granular(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    ds = ShardedDataset(d, seed=9, block_size=16)
+    it = ds.iterator(epoch=4, batch_size=8, pad_to=8)
+    ref = [x.tobytes() for x, _y, _w in it][3:]
+    it2 = ds.iterator(epoch=4, batch_size=8, pad_to=8)
+    for _ in range(3):
+        next(it2)
+    state = it2.state()
+    assert state == {"epoch": 4, "batch": 3, "samples": 24}
+    it3 = CheckpointableIterator.restore(ds, state, 8, pad_to=8)
+    assert [x.tobytes() for x, _y, _w in it3] == ref
+    # sample (not batch) granularity: restart mid-batch
+    it4 = ds.iterator(epoch=4, batch_size=8, pad_to=8, start_samples=20)
+    x, _y, _w = next(it4)
+    flat = _rows(ds, 4, 8)
+    assert [r.tobytes() for r in x] == flat[20:28]
+
+
+def test_equalized_steps_across_unbalanced_shards(tmp_path, rng):
+    """A shard short on rows pads the epoch tail with zero-weight
+    batches so every shard emits the same step count (pod lockstep)."""
+    d = tmp_path / "uneven"
+    d.mkdir()
+    _write_file(d / "a.hdf5", rng, 48, "a")  # 3 blocks of 16
+    shards = [
+        ShardedDataset(str(d), seed=0, block_size=16, num_shards=2, shard_id=s)
+        for s in (0, 1)
+    ]
+    assert shards[0].local_rows() == 32 and shards[1].local_rows() == 16
+    assert all(ds.steps_per_epoch(8) == 4 for ds in shards)
+    outs = [
+        list(ds.batches(8, rng=ds.epoch_rng(0), pad_to=8)) for ds in shards
+    ]
+    assert len(outs[0]) == len(outs[1]) == 4
+    real = [sum(int(w.sum()) for _x, _y, w in o) for o in outs]
+    assert real == [32, 16]  # the padding batches carry zero weight
+    assert all(w.sum() == 0 for _x, _y, w in outs[1][2:])
+
+
+def test_split_holdout_partitions_rows(tmp_path, rng):
+    d = _corpus(tmp_path, rng)
+    ds = ShardedDataset(d, seed=0, block_size=16, num_shards=2, shard_id=0)
+    tr, va = ds.split_holdout(0.25, seed=3)
+    assert len(va) == 30 and len(tr) == 90
+    assert (va.num_shards, tr.num_shards) == (1, 2)  # val is unsharded
+    all_rows = set(_rows(ShardedDataset(d, seed=0, block_size=16), 0, 8))
+    va_rows = set(_rows(va, 0, 8))
+    tr_rows = set(_rows(tr.unsharded(), 0, 8))
+    assert va_rows | tr_rows == all_rows and not (va_rows & tr_rows)
+    # deterministic: the same split on a fresh dataset object
+    tr2, va2 = ShardedDataset(
+        d, seed=0, block_size=16, num_shards=2, shard_id=0
+    ).split_holdout(0.25, seed=3)
+    assert set(_rows(va2, 0, 8)) == va_rows
+
+
+# -- legacy dataset delegation ------------------------------------------
+
+
+def test_inmemory_delegation_keeps_contract(rng):
+    from roko_tpu.training.data import InMemoryDataset
+
+    X = rng.integers(0, 12, (40, 4, 6)).astype(np.uint8)
+    Y = (X.sum(axis=1) % 5).astype(np.int64)
+    ds = InMemoryDataset(X, Y)
+    batches = list(ds.batches(16, pad_to=16))  # no rng: natural order
+    assert len(batches) == 3
+    np.testing.assert_array_equal(batches[0][0], X[:16])
+    x, _y, w = batches[2]
+    assert x.shape[0] == 16 and w.sum() == 8.0
+    # shuffled epoch covers every row exactly once
+    seen = []
+    for x, _y, w in ds.batches(16, rng=np.random.default_rng(0), pad_to=16):
+        seen.extend(r.tobytes() for r in x[: int(w.sum())])
+    assert sorted(seen) == sorted(r.tobytes() for r in X)
+
+
+def test_streaming_delegation_matches_sharded_dataset(tmp_path, rng):
+    """StreamingDataset (chunk table) and ShardedDataset (manifest) ride
+    the same engine: same chunk/block size + same rng => the same
+    stream, byte for byte."""
+    from roko_tpu.training.lazy_data import StreamingDataset
+
+    d = _corpus(tmp_path, rng)
+    lazy = StreamingDataset(d, chunk_size=16, buffer_chunks=2)
+    sharded = ShardedDataset(d, seed=4, block_size=16)
+    a = []
+    for x, _y, w in lazy.batches(
+        8, rng=np.random.default_rng(np.random.SeedSequence([4, 0])), pad_to=8
+    ):
+        a.extend(r.tobytes() for r in x[: int(w.sum())])
+    assert a == _rows(sharded, 0, 8)
+
+
+# -- config + CLI --------------------------------------------------------
+
+
+def test_data_config_json_roundtrip():
+    cfg = RokoConfig(
+        data=DataConfig(shards=4, shard_id=2, seed=9, block_size=128)
+    )
+    cfg2 = RokoConfig.from_json(cfg.to_json())
+    assert cfg2.data == cfg.data
+    assert RokoConfig.from_json("{}").data == DataConfig()
+
+
+def test_data_cli_flags_layer_over_config(tmp_path):
+    from roko_tpu.cli import _build_config, build_parser
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(
+        RokoConfig(data=DataConfig(block_size=64, input_prefetch=7)).to_json()
+    )
+    args = build_parser().parse_args(
+        [
+            "train", "in.hdf5", "out",
+            "--config", str(cfg_path),
+            "--data-shards", "4",
+            "--data-shard-id", "1",
+            "--data-seed", "13",
+            "--data-manifest", "/tmp/m.json",
+        ]
+    )
+    data = _build_config(args).data
+    assert (data.shards, data.shard_id, data.seed) == (4, 1, 13)
+    assert data.block_size == 64 and data.input_prefetch == 7  # file layer
+    assert data.manifest == "/tmp/m.json"
+    args = build_parser().parse_args(
+        ["train", "in.hdf5", "out", "--input-prefetch", "5"]
+    )
+    assert _build_config(args).data.input_prefetch == 5
+
+
+# -- training-loop integration ------------------------------------------
+
+
+def _train_h5(tmp_path, rng, n=64):
+    X = rng.integers(
+        0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    pos = [
+        np.stack([np.arange(C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1)
+    ] * n
+    h5 = str(tmp_path / "train.hdf5")
+    with DataWriter(h5, infer=False) as w:
+        w.write_contigs([("c", "ACGT" * 100)])
+        w.store("c", pos, list(X), list(Y))
+    return h5
+
+
+def _sharded_cfg(shard_id=0, guard=None, **train_kw):
+    kw = dict(batch_size=16, epochs=2, lr=1e-2)
+    kw.update(train_kw)
+    return RokoConfig(
+        model=TINY,
+        train=TrainConfig(**kw),
+        data=DataConfig(shards=2, shard_id=shard_id, block_size=16),
+        mesh=MeshConfig(dp=8),
+        guard=guard if guard is not None else GuardConfig(),
+    )
+
+
+def _leaves(params):
+    return jax.tree_util.tree_leaves_with_path(jax.device_get(params))
+
+
+def _assert_params_equal(a, b):
+    fa, fb = _leaves(a), dict(_leaves(b))
+    assert fa and len(fa) == len(fb)
+    for path, leaf in fa:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(fb[path]),
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged",
+        )
+
+
+def test_train_loop_sharded_single_host(rng, tmp_path):
+    """--data-shards 2 --data-shard-id 0 on one host: the loop streams
+    shard 0's half at half the global batch, logs the shard spec, and
+    completes the equalised step budget."""
+    h5 = _train_h5(tmp_path, rng)
+    logs = []
+    state = train(
+        _sharded_cfg(), h5, str(tmp_path / "ckpt"), log=logs.append
+    )
+    # 64 rows, 4 blocks of 16, shard 0 owns 2 blocks = 32 rows;
+    # local batch 8 -> 4 equalised steps/epoch x 2 epochs
+    assert int(jax.device_get(state.step)) == 2 * 4
+    assert any("[shard 0/2: 32 local rows" in l for l in logs)
+
+
+def test_sharded_mid_epoch_interrupt_resumes_bit_identical(rng, tmp_path):
+    """Acceptance: kill mid-epoch + resume on a 2-shard run is
+    bit-identical (params AND loss curve) to an uninterrupted run —
+    the sharded stream fast-forwards to the exact sample. (Real-SIGKILL
+    subprocess variant: test_fault_injection.py, slow lane.)"""
+    h5 = _train_h5(tmp_path, rng)
+    guard = GuardConfig(save_every_steps=2)
+
+    logs_a = []
+    state_a = train(
+        _sharded_cfg(guard=guard, log_every_steps=1),
+        h5, str(tmp_path / "ckpt_a"), log=logs_a.append,
+    )
+
+    class _Interrupt(Exception):
+        pass
+
+    def interrupting_log(msg):
+        if "epoch 1 step 3/4" in msg:
+            raise _Interrupt(msg)
+
+    with pytest.raises(_Interrupt):
+        train(
+            _sharded_cfg(guard=guard, log_every_steps=1),
+            h5, str(tmp_path / "ckpt_b"), log=interrupting_log,
+        )
+    logs_b = []
+    state_b = train(
+        _sharded_cfg(guard=guard, log_every_steps=1),
+        h5, str(tmp_path / "ckpt_b"), log=logs_b.append,
+    )
+    assert any(
+        "resumed from step 6 (epoch 1, batch 2," in l for l in logs_b
+    ), logs_b[:6]
+    _assert_params_equal(state_a.params, state_b.params)
+
+    def epoch_metrics(logs, epoch):
+        for l in logs:
+            m = re.match(
+                rf"epoch {epoch}: (train_loss \S+ val_acc \S+ val_loss \S+)", l
+            )
+            if m:
+                return m.group(1)
+        raise AssertionError(f"no epoch {epoch} summary in {logs}")
+
+    assert epoch_metrics(logs_a, 1) == epoch_metrics(logs_b, 1)
+
+
+def test_resume_refuses_changed_shard_topology(rng, tmp_path):
+    h5 = _train_h5(tmp_path, rng)
+    train(
+        _sharded_cfg(epochs=1), h5, str(tmp_path / "ckpt"),
+        log=lambda s: None,
+    )
+    unsharded = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=2, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    with pytest.raises(
+        RuntimeError, match=r"data-stream spec changed.*shards: 2 -> 1"
+    ):
+        train(unsharded, h5, str(tmp_path / "ckpt"), log=lambda s: None)
+    # a changed stream seed (or block size) is refused the same way —
+    # the epoch stream is a pure function of every pinned field
+    reseeded = _sharded_cfg(epochs=2)
+    reseeded = RokoConfig(
+        model=reseeded.model, train=reseeded.train, mesh=reseeded.mesh,
+        guard=reseeded.guard,
+        data=DataConfig(shards=2, shard_id=0, block_size=16, seed=7),
+    )
+    with pytest.raises(
+        RuntimeError, match=r"data-stream spec changed.*seed: 0 -> 7"
+    ):
+        train(reseeded, h5, str(tmp_path / "ckpt"), log=lambda s: None)
+
+
+def test_mid_epoch_resume_refuses_changed_batch_size(rng, tmp_path):
+    """The persisted position counts LOCAL batches, so a MID-epoch
+    resume with a different batch size would land at the wrong sample
+    — refused. An epoch-BOUNDARY resume with a new batch size stays a
+    supported workflow (test_train_resume_from_checkpoint)."""
+    h5 = _train_h5(tmp_path, rng)
+
+    def cfg(batch, epochs):
+        return RokoConfig(
+            model=TINY,
+            train=TrainConfig(
+                batch_size=batch, epochs=epochs, lr=1e-2, log_every_steps=1
+            ),
+            mesh=MeshConfig(dp=8),
+            guard=GuardConfig(save_every_steps=1),
+        )
+
+    class _Interrupt(Exception):
+        pass
+
+    def interrupting_log(msg):
+        if "epoch 0 step 3/4" in msg:
+            raise _Interrupt(msg)
+
+    with pytest.raises(_Interrupt):
+        train(cfg(16, 1), h5, str(tmp_path / "ckpt"), log=interrupting_log)
+    with pytest.raises(
+        RuntimeError, match=r"data-stream spec changed.*local_bs: 16 -> 8"
+    ):
+        train(cfg(8, 1), h5, str(tmp_path / "ckpt"), log=lambda s: None)
+    # same batch size resumes fine from the mid-epoch position
+    logs = []
+    train(cfg(16, 1), h5, str(tmp_path / "ckpt"), log=logs.append)
+    assert any("resumed from step 2 (epoch 0, batch 2," in l for l in logs)
+
+
+def test_resume_refuses_changed_val_fraction(rng, tmp_path):
+    """The holdout split shapes the train stream, so a resumed run with
+    a different --val-fraction refuses instead of silently leaking
+    held-out rows into training (or vice versa)."""
+    h5 = _train_h5(tmp_path, rng)
+
+    def cfg(fraction, epochs):
+        return RokoConfig(
+            model=TINY,
+            train=TrainConfig(
+                batch_size=16, epochs=epochs, lr=1e-2,
+                val_fraction=fraction,
+            ),
+            mesh=MeshConfig(dp=8),
+        )
+
+    train(cfg(0.25, 1), h5, str(tmp_path / "ckpt"), log=lambda s: None)
+    with pytest.raises(
+        RuntimeError,
+        match=r"data-stream spec changed.*val_ppm: 250000 -> 500000",
+    ):
+        train(cfg(0.5, 2), h5, str(tmp_path / "ckpt"), log=lambda s: None)
+
+
+def test_resume_refuses_mutated_corpus(rng, tmp_path):
+    """The checkpoint pins the corpus fingerprint: regenerating the
+    training data mid-run would silently shift every stream, so resume
+    refuses instead."""
+    h5 = _train_h5(tmp_path, rng)
+    cfg = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=1, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    train(cfg, h5, str(tmp_path / "ckpt"), log=lambda s: None)
+    _train_h5(tmp_path, np.random.default_rng(999))  # regenerate in place
+    cfg2 = RokoConfig(
+        model=TINY,
+        train=TrainConfig(batch_size=16, epochs=2, lr=1e-2),
+        mesh=MeshConfig(dp=8),
+    )
+    with pytest.raises(
+        RuntimeError, match=r"data-stream spec changed.*fp_"
+    ):
+        train(cfg2, h5, str(tmp_path / "ckpt"), log=lambda s: None)
+
+
+def test_pre_datapipe_checkpoint_layout_still_restores(tmp_path):
+    """A PR5-era checkpoint (data_state WITHOUT the nested 'pipe'
+    bookkeeping) must restore under the new template: the restore
+    target is filtered per candidate at EVERY nesting level, so new
+    nested keys never make orbax refuse an old checkpoint."""
+    import jax.numpy as jnp
+
+    from roko_tpu.training.checkpoint import CheckpointManager
+
+    old_state = {
+        "params": {"w": jnp.arange(4, dtype=jnp.float32)},
+        "opt_state": {"m": jnp.zeros(4)},
+        "step": jnp.asarray(6, jnp.int32),
+        "data_state": {
+            "epoch": jnp.asarray(1, jnp.int32),
+            "batch": jnp.asarray(2, jnp.int32),
+            "guard": {"rollbacks": jnp.zeros((), jnp.int32)},
+        },
+    }
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), log=lambda s: None)
+    mgr.save(6, old_state, val_acc=0.5)
+    template = {
+        "params": old_state["params"],
+        "opt_state": old_state["opt_state"],
+        "step": jnp.zeros((), jnp.int32),
+        "epoch": jnp.zeros((), jnp.int32),  # absent on disk: dropped
+        "data_state": {
+            "epoch": jnp.zeros((), jnp.int32),
+            "batch": jnp.zeros((), jnp.int32),
+            "applied": jnp.zeros((), jnp.int32),  # absent: dropped
+            "guard": {
+                "rollbacks": jnp.zeros((), jnp.int32),
+                "ema": jnp.zeros((), jnp.float32),  # absent: dropped
+            },
+            "pipe": {  # whole subtree absent on disk: dropped
+                "shards": jnp.zeros((), jnp.int32),
+                "fp_hi": jnp.zeros((), jnp.int32),
+            },
+        },
+    }
+    restored = mgr.restore_latest(template=template)
+    mgr.close()
+    assert int(np.asarray(restored["step"])) == 6
+    ds = restored["data_state"]
+    assert int(np.asarray(ds["batch"])) == 2
+    assert "pipe" not in ds and "applied" not in ds
+    assert "ema" not in ds["guard"]
+
+
+def test_bench_input_suite_smoke():
+    from roko_tpu.benchmark import run_input_suite
+
+    # 192 rows / 2 files -> six 32-row blocks, uniform 2-block mix
+    # groups, so the half-epoch fast-forward provably skips reads
+    out = run_input_suite(rows=192, files=2, batch=16)
+    assert out["shard2_union_ok"]
+    assert out["datapipe_stream"]["rows_read"] == 192
+    assert out["legacy_stream"]["rows_per_sec"] > 0
+    assert out["fast_forward"]["datapipe_rows_read"] < 192
+
+
+# -- two-process simulated hosts (CI datapipe-shard job, slow lane) -----
+
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import sys as _s
+if "jax" in _s.modules:
+    import jax; jax.config.update("jax_platforms", "cpu")
+
+root, pid, port, h5, ckpt = sys.argv[1:6]
+sys.path.insert(0, root)
+os.environ["ROKO_COORDINATOR"] = f"127.0.0.1:{port}"
+os.environ["ROKO_NUM_PROCESSES"] = "2"
+os.environ["ROKO_PROCESS_ID"] = pid
+
+import hashlib
+import numpy as np
+import jax
+from roko_tpu.config import (
+    DataConfig, MeshConfig, ModelConfig, RokoConfig, TrainConfig,
+)
+from roko_tpu.training.loop import train
+
+cfg = RokoConfig(
+    model=ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1),
+    train=TrainConfig(batch_size=16, epochs=2, lr=1e-2),
+    data=DataConfig(block_size=16),  # shards auto = 2 pod processes
+    mesh=MeshConfig(dp=8),
+)
+state = train(cfg, h5, ckpt)
+assert jax.process_count() == 2, jax.process_count()
+
+h = hashlib.sha256()
+for path, leaf in jax.tree_util.tree_leaves_with_path(
+    jax.device_get(state.params)
+):
+    h.update(jax.tree_util.keystr(path).encode())
+    h.update(np.ascontiguousarray(leaf).tobytes())
+print(f"WORKER_{pid}_OK digest={h.hexdigest()}", flush=True)
+"""
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_train_deterministic(rng, tmp_path):
+    """Two real jax.distributed processes, each streaming its own shard
+    of the corpus (auto shard spec from process_index): the run
+    completes, both processes agree on the replicated params, and a
+    SECOND identical 2-process run reproduces them bit-identically —
+    the simulated-pod determinism contract of the sharded data plane."""
+    h5 = _train_h5(tmp_path, rng)
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS", "XLA_FLAGS")
+    }
+
+    def run_fleet(tag):
+        port = _free_port()
+        procs = [
+            subprocess.Popen(
+                [
+                    sys.executable, str(script), root, str(p), str(port),
+                    h5, str(tmp_path / f"ckpt_{tag}"),
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            )
+            for p in (0, 1)
+        ]
+        outs = [p.communicate(timeout=840)[0] for p in procs]
+        if any(
+            "Multiprocess computations aren't implemented" in o for o in outs
+        ):
+            pytest.skip(
+                "this jax build has no CPU multiprocess collectives"
+            )
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+        digests = []
+        for p, out in zip((0, 1), outs):
+            m = re.search(rf"WORKER_{p}_OK digest=([0-9a-f]+)", out)
+            assert m, out[-2000:]
+            digests.append(m.group(1))
+        assert digests[0] == digests[1], "processes diverged on params"
+        return digests[0]
+
+    assert run_fleet("a") == run_fleet("b"), (
+        "two identical 2-process sharded runs produced different params"
+    )

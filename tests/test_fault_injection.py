@@ -573,6 +573,161 @@ def test_sigkill_mid_epoch_step_granular_resume(tmp_path):
         )
 
 
+_CHILD_TRAIN_SHARDED = """\
+import sys
+
+sys.path.insert(0, {repo_root!r})
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from roko_tpu.config import (
+    DataConfig, GuardConfig, MeshConfig, ModelConfig, RokoConfig,
+    TrainConfig,
+)
+from roko_tpu.training.loop import train
+
+cfg = RokoConfig(
+    model=ModelConfig(
+        embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+    ),
+    train=TrainConfig(
+        batch_size=16, epochs=3, lr=1e-2, in_memory=False,
+        log_every_steps=1,
+    ),
+    data=DataConfig(shards=2, shard_id=0, block_size=16),
+    mesh=MeshConfig(dp=8),
+    guard=GuardConfig(save_every_steps=1),
+)
+train(cfg, sys.argv[1], sys.argv[2], log=lambda m: print(m, flush=True))
+print("TRAIN_DONE", flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_sigkill_mid_epoch_sharded_resume(tmp_path):
+    """The sharded-data-plane variant of the step-granular kill test:
+    SIGKILL mid-epoch on a 2-shard streaming run (shard 0 of 2,
+    save_every_steps=1), restart the identical command, and the resumed
+    run must finish with a bit-identical loss curve and final params to
+    a never-interrupted run — the sharded stream fast-forwards to the
+    exact sample, the checkpoint pins the shard topology and corpus
+    fingerprint (tests/test_datapipe.py holds the in-process variant)."""
+    import re
+    import subprocess
+    import sys as _sys
+
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import (
+        DataConfig, GuardConfig, MeshConfig, ModelConfig, RokoConfig,
+        TrainConfig,
+    )
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.training.loop import train
+
+    rng = np.random.default_rng(79)
+    X = rng.integers(
+        0, C.FEATURE_VOCAB, (64, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    Y = (X.sum(axis=1) % C.NUM_CLASSES).astype(np.int64)
+    h5 = str(tmp_path / "train.hdf5")
+    pos = [
+        np.stack([np.arange(C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1)
+    ] * len(X)
+    with DataWriter(h5, infer=False) as w:
+        w.write_contigs([("c", "ACGT" * 100)])
+        w.store("c", pos, list(X), list(Y))
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child_sharded.py"
+    script.write_text(_CHILD_TRAIN_SHARDED.format(repo_root=repo_root))
+    ckpt = str(tmp_path / "ckpt_killed")
+    cmd = [_sys.executable, str(script), h5, ckpt]
+
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        bufsize=1,
+        cwd=repo_root,
+    )
+    killed = False
+    child_lines = []
+    assert proc.stdout is not None
+    for line in proc.stdout:
+        child_lines.append(line)
+        if "epoch 1 step 2/4" in line:
+            proc.kill()
+            killed = True
+            break
+    proc.wait(timeout=60)
+    assert killed, (
+        "child exited before the kill landed; its output was:\n"
+        + "".join(child_lines[-30:])
+    )
+
+    done = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=repo_root, timeout=900
+    )
+    assert done.returncode == 0, done.stdout + done.stderr
+    assert "TRAIN_DONE" in done.stdout
+    assert "resumed from step" in done.stdout
+
+    cfg = RokoConfig(
+        model=ModelConfig(
+            embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+        ),
+        train=TrainConfig(
+            batch_size=16, epochs=3, lr=1e-2, in_memory=False,
+            log_every_steps=1,
+        ),
+        data=DataConfig(shards=2, shard_id=0, block_size=16),
+        mesh=MeshConfig(dp=8),
+        guard=GuardConfig(save_every_steps=1),
+    )
+    clean_logs = []
+    ckpt_clean = str(tmp_path / "ckpt_clean")
+    train(cfg, h5, ckpt_clean, log=clean_logs.append)
+
+    # loss-curve identity: the final epoch's summary metrics match the
+    # killed+resumed run exactly
+    def metrics(lines, epoch):
+        for l in lines:
+            m = re.match(
+                rf"epoch {epoch}: (train_loss \S+ val_acc \S+ val_loss \S+)",
+                l,
+            )
+            if m:
+                return m.group(1)
+        raise AssertionError(f"no epoch {epoch} summary")
+
+    assert metrics(done.stdout.splitlines(), 2) == metrics(clean_logs, 2)
+
+    from roko_tpu.training.checkpoint import CheckpointManager
+
+    ma, mb = CheckpointManager(ckpt), CheckpointManager(ckpt_clean)
+    try:
+        a, b = ma.restore_latest(), mb.restore_latest()
+    finally:
+        ma.close()
+        mb.close()
+    assert int(np.asarray(a["step"])) == int(np.asarray(b["step"]))
+    flat_a = jax.tree_util.tree_leaves_with_path(a["params"])
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(b["params"]))
+    assert flat_a and len(flat_a) == len(flat_b)
+    for path, leaf in flat_a:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(flat_b[path]),
+            err_msg=f"param {jax.tree_util.keystr(path)} diverged "
+            "across sharded kill/resume",
+        )
+
+
 def test_dead_worker_recovered_via_timeout(project, monkeypatch):
     """A worker that dies (os._exit) loses its in-flight job — imap
     would wait forever. With job_timeout the pool is abandoned and the

@@ -416,9 +416,10 @@ def test_val_fraction_holdout_enables_early_stopping(rng, tmp_path):
     assert not any("early stopping disabled" in l for l in logs)
 
 
-def test_val_fraction_requires_in_memory(rng, tmp_path):
-    import pytest as _pytest
-
+def test_val_fraction_works_with_streaming(rng, tmp_path):
+    """--val-fraction used to require --memory; the sharded data plane
+    does the holdout as index arithmetic over the manifest, so the
+    streaming path splits too (docs/TRAINING.md)."""
     X, Y = _window_batch(rng, 32)
     _write_train_hdf5(tmp_path / "train.hdf5", X, Y)
     cfg = RokoConfig(
@@ -428,8 +429,13 @@ def test_val_fraction_requires_in_memory(rng, tmp_path):
         ),
         mesh=MeshConfig(dp=8),
     )
-    with _pytest.raises(ValueError, match="val-fraction"):
-        train(cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"))
+    logs = []
+    train(
+        cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=logs.append,
+    )
+    assert any("held out 8 of 32" in l for l in logs)
+    assert not any("early stopping disabled" in l for l in logs)
 
 
 def test_in_epoch_heartbeat(rng, tmp_path):
