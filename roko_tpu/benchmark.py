@@ -1041,6 +1041,13 @@ def _measure(args) -> Dict[str, Any]:
         except Exception as e:  # report, never swallow
             detail["input"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("input", detail["input"])
+    # an EXPLICIT --serve-mix also threads the mixed workload through
+    # the fleet suite (per-size-class latency + per-worker padding
+    # efficiency for both batching modes); the default driver run keeps
+    # the fleet suite's flat single-size cost
+    explicit_mix = getattr(args, "serve_mix", None)
+    if explicit_mix in ("0", "off", ""):
+        explicit_mix = None
     fleet_workers = getattr(args, "fleet_workers", None)
     if fleet_workers is None:
         # default follows the e2e scale decision (as coldstart):
@@ -1050,11 +1057,31 @@ def _measure(args) -> Dict[str, Any]:
         _stamp(f"fleet suite (workers {tuple(fleet_workers)})")
         try:
             detail["fleet"] = run_fleet_suite(
-                fleet_workers, iterations=bench_iters or FLEET_ITERS
+                fleet_workers, iterations=bench_iters or FLEET_ITERS,
+                mix=explicit_mix,
             )
         except Exception as e:  # report, never swallow
             detail["fleet"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("fleet", detail["fleet"])
+    serve_mix = getattr(args, "serve_mix", None)
+    if serve_mix is None:
+        # default follows the e2e scale decision; the large class
+        # scales to the backend (a 256-window request is cheap on TPU,
+        # signal-burying on the 2-core CPU box)
+        serve_mix = (
+            (SERVE_MIX_DEFAULT_TPU if jax.default_backend() == "tpu"
+             else SERVE_MIX_DEFAULT_CPU)
+            if e2e_draft else ""
+        )
+    if serve_mix and serve_mix not in ("0", "off"):
+        _stamp(f"serve suite (mixed sizes {serve_mix}, both batching modes)")
+        try:
+            detail["serve"] = run_serve_suite(
+                serve_mix, iterations=bench_iters or SERVE_SUITE_REQUESTS
+            )
+        except Exception as e:  # report, never swallow
+            detail["serve"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("serve", detail["serve"])
     _stamp("torch reference")
     ref_windows_per_sec = bench_torch_reference()
     # provenance: which stack produced this artifact (BENCH_r{N}.json is
@@ -1249,6 +1276,8 @@ def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
             ]
         if getattr(args, "bench_iterations", None) is not None:
             cmd += ["--bench-iterations", str(args.bench_iterations)]
+        if getattr(args, "serve_mix", None) is not None:
+            cmd += ["--serve-mix", args.serve_mix]
         repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         rc, out = _spawn_logged(cmd, budget_s, cwd=repo_root)
         if rc == 0:
@@ -1708,6 +1737,227 @@ FLEET_CLIENTS = 3
 #: single padded dispatch and req/s compares across worker counts
 FLEET_REQUEST_WINDOWS = 8
 
+#: mixed-size serve suite defaults (ISSUE: 90% small / 10% large; the
+#: large class scales to the backend — a 256-window request on the
+#: 2-core CPU box would bury the scheduling signal under raw compute)
+SERVE_MIX_DEFAULT_TPU = "4:90,256:10"
+SERVE_MIX_DEFAULT_CPU = "4:90,64:10"
+#: total requests per batching mode (overridden by --bench-iterations)
+SERVE_SUITE_REQUESTS = 48
+SERVE_SUITE_CLIENTS = 6
+
+
+def _parse_mix(spec: str):
+    """``"4:90,256:10"`` -> ``((4, 90.0), (256, 10.0))`` — window count
+    per request : percent of requests. Percents must sum to ~100."""
+    out = []
+    try:
+        for part in spec.split(","):
+            size, pct = part.split(":")
+            out.append((int(size), float(pct)))
+    except ValueError:
+        raise ValueError(
+            f"bad --serve-mix {spec!r}; want SIZE:PCT[,SIZE:PCT...] "
+            "like 4:90,256:10"
+        ) from None
+    if not out or any(s <= 0 or p < 0 for s, p in out):
+        raise ValueError(f"bad --serve-mix {spec!r}: sizes must be positive")
+    total = sum(p for _, p in out)
+    if not 99.0 <= total <= 101.0:
+        raise ValueError(
+            f"--serve-mix percents sum to {total:g}, want ~100"
+        )
+    return tuple(out)
+
+
+def _mix_schedule(mix, total_requests: int, seed: int = 0):
+    """Deterministic request-size schedule: per-class counts rounded
+    from the percents (every named class gets >= 1 request), shuffled
+    with a fixed seed so both batching modes replay IDENTICAL work."""
+    sizes = []
+    for size, pct in mix:
+        count = max(1, round(total_requests * pct / 100.0)) if pct else 0
+        sizes += [size] * count
+    np.random.default_rng(seed).shuffle(sizes)
+    return sizes
+
+
+def _mixed_latency_row(
+    wall: float, n_scheduled: int, lat: Dict[int, list]
+) -> Dict[str, Any]:
+    """One artifact row for a mixed-size run — shared by the serve
+    suite and the fleet suite's mixed phase so the two report the
+    identical schema. ``req_per_s`` counts COMPLETED requests (the
+    per-class samples), not the schedule — errored requests must not
+    inflate throughput."""
+    completed = sum(len(s) for s in lat.values())
+    row: Dict[str, Any] = {
+        "wall_s": round(wall, 3),
+        "requests_scheduled": n_scheduled,
+        "req_per_s": round(completed / wall, 2) if wall else 0.0,
+        "size_classes": {},
+    }
+    for size, samples in sorted(lat.items()):
+        if samples:
+            row["size_classes"][str(size)] = {
+                "requests": len(samples),
+                "p50_s": round(float(np.percentile(samples, 50)), 4),
+                "p99_s": round(float(np.percentile(samples, 99)), 4),
+            }
+    return row
+
+
+def run_serve_suite(
+    mix_spec: str,
+    iterations: int = SERVE_SUITE_REQUESTS,
+    clients: int = SERVE_SUITE_CLIENTS,
+    config_json: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Mixed-size workload A/B of the serve batching policies
+    (docs/SERVING.md "Continuous batching"): the SAME fixed, seeded
+    request schedule — e.g. 90% 4-window / 10% 256-window — is driven
+    closed-loop by ``clients`` threads against one warm PolishSession
+    under the deadline coalescer and then the continuous scheduler,
+    recording per mode: ``padding_efficiency`` (real windows ÷
+    rung×steps), per-size-class p50/p99 latency, req/s, and a
+    byte-identity check of every reply against a solo
+    ``session.predict`` (the batch-CLI path). Headline comparisons:
+    ``small_p99_improvement`` (deadline p99 / continuous p99 for the
+    smallest class — the head-of-line-blocking cost) and the two
+    padding efficiencies side by side (ISSUE acceptance)."""
+    import dataclasses
+    import threading
+
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import RokoConfig
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.serve.batcher import MicroBatcher
+    from roko_tpu.serve.metrics import ServeMetrics
+    from roko_tpu.serve.scheduler import ContinuousBatcher
+    from roko_tpu.serve.session import PolishSession
+
+    mix = _parse_mix(mix_spec)
+    cfg = RokoConfig.from_json(config_json) if config_json else RokoConfig()
+    large = max(s for s, _ in mix)
+    # the flagship ladder SHAPE at suite scale: a bottom rung for
+    # sparse-traffic tails, a coarse middle rung, and a top rung sized
+    # to the large class — both modes get the identical ladder, so the
+    # A/B isolates the scheduling policy, not the rung set
+    ladder = tuple(sorted({min(8, large), min(32, large), large}))
+    cfg = dataclasses.replace(
+        cfg, serve=dataclasses.replace(cfg.serve, ladder=ladder)
+    )
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+    session = PolishSession(params, cfg)
+    session.warmup()
+
+    rng = np.random.default_rng(0)
+    rows, cols = cfg.model.window_rows, cfg.model.window_cols
+    payloads = {
+        size: rng.integers(0, C.FEATURE_VOCAB, (size, rows, cols)).astype(
+            np.uint8
+        )
+        for size, _ in mix
+    }
+    expected = {size: session.predict(x) for size, x in payloads.items()}
+    schedule = _mix_schedule(mix, iterations)
+
+    def drive(mode: str) -> Dict[str, Any]:
+        metrics = ServeMetrics()
+        metrics.size_classes = ladder
+        if mode == "continuous":
+            batcher = ContinuousBatcher(
+                session, metrics=metrics, max_queue=clients * 2
+            )
+        else:
+            batcher = MicroBatcher(
+                session, metrics=metrics, max_queue=clients * 2
+            )
+        lat: Dict[int, list] = {size: [] for size, _ in mix}
+        mismatches: list = []
+        errors: list = []
+        lock = threading.Lock()
+        work = list(schedule)
+
+        def one_client():
+            while True:
+                with lock:
+                    if not work:
+                        return
+                    size = work.pop()
+                t0 = time.perf_counter()
+                try:
+                    preds = batcher.predict(payloads[size], timeout=600.0)
+                except Exception as e:
+                    # a failed request must be COUNTED, not silently
+                    # vanish with its thread — byte_identical would
+                    # otherwise pass vacuously
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}"[:200])
+                    continue
+                dt = time.perf_counter() - t0
+                ok = np.array_equal(preds, expected[size])
+                with lock:
+                    lat[size].append(dt)
+                    if not ok:
+                        mismatches.append(size)
+
+        try:
+            # untimed calibration: one request per class warms the
+            # throughput EMA and keeps first-dispatch cost off-clock
+            for size in payloads:
+                batcher.predict(payloads[size], timeout=600.0)
+            # snapshot the fill counters so the solo calibration
+            # dispatches (heavily padded by construction) can't skew
+            # the reported padding_efficiency
+            cal_windows, cal_padded = metrics.fill_totals()
+            threads = [
+                threading.Thread(target=one_client, daemon=True)
+                for _ in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        finally:
+            batcher.stop()
+        fill_windows, fill_padded = metrics.fill_totals()
+        padded = fill_padded - cal_padded
+        row = _mixed_latency_row(wall, len(schedule), lat)
+        row["padding_efficiency"] = (
+            round((fill_windows - cal_windows) / padded, 4) if padded else 0.0
+        )
+        row["byte_identical"] = not mismatches and not errors
+        row["client_errors"] = len(errors)
+        if errors:
+            row["errors"] = errors[:5]
+        return row
+
+    results: Dict[str, Any] = {
+        "mix": mix_spec,
+        "iterations": len(schedule),
+        "clients": clients,
+        "ladder": list(ladder),
+        "modes": {},
+    }
+    # calibration order fixed (deadline first) so cross-round artifacts
+    # compare like with like
+    for mode in ("deadline", "continuous"):
+        results["modes"][mode] = drive(mode)
+    small = str(min(s for s, _ in mix))
+    try:
+        d = results["modes"]["deadline"]["size_classes"][small]["p99_s"]
+        c = results["modes"]["continuous"]["size_classes"][small]["p99_s"]
+        if c > 0:
+            results["small_p99_improvement"] = round(d / c, 3)
+    except KeyError:
+        pass
+    return results
+
 
 def run_fleet_suite(
     worker_counts=(1, 2),
@@ -1715,6 +1965,7 @@ def run_fleet_suite(
     clients: int = FLEET_CLIENTS,
     config_json: Optional[str] = None,
     startup_budget_s: float = 600.0,
+    mix: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Saturation + fault tolerance of the multi-worker serving tier
     (serve/fleet.py): FIXED-WORK closed-loop load — ``clients`` client
@@ -1728,7 +1979,12 @@ def run_fleet_suite(
     Workers are real subprocesses (full serve stack each); when the
     bench parent owns a TPU the workers are pinned to CPU instead of
     fighting over chips the parent holds — the suite then measures the
-    routing/supervision tier, honestly labeled in ``note``."""
+    routing/supervision tier, honestly labeled in ``note``.
+
+    ``mix`` (an explicit ``--serve-mix`` spec) adds a mixed-size phase
+    at the top worker count for BOTH batching modes on the identical
+    seeded schedule: per-size-class p50/p99 plus each worker's scraped
+    ``padding_efficiency`` land in ``results["mixed"]``."""
     import dataclasses
     import tempfile
     import threading
@@ -1746,6 +2002,10 @@ def run_fleet_suite(
     cfg = (
         RokoConfig.from_json(config_json) if config_json else RokoConfig()
     )
+    # validate the mix spec BEFORE the expensive saturation/kill phases:
+    # a typo'd --serve-mix must fail here, not discard minutes of
+    # completed real-subprocess measurement at the final mixed phase
+    mix_parsed = _parse_mix(mix) if mix else None
     worker_env_extra: Dict[str, str] = {}
     results: Dict[str, Any] = {
         "iterations": iterations,
@@ -1797,15 +2057,16 @@ def run_fleet_suite(
                 ).to_json()
             )
 
-        def start_fleet(n: int):
+        def start_fleet(n: int, run_cfg=None, worker_cfg_path=None, tag=""):
             fcfg = dataclasses.replace(
-                cfg, fleet=dataclasses.replace(cfg.fleet, workers=n)
+                run_cfg or cfg,
+                fleet=dataclasses.replace(cfg.fleet, workers=n),
             )
             fleet = Fleet(
                 fcfg,
-                worker_command(ckpt, cfg_path),
+                worker_command(ckpt, worker_cfg_path or cfg_path),
                 worker_env=lambda wid: dict(worker_env_extra),
-                runtime_dir=os.path.join(td, f"fleet-{n}"),
+                runtime_dir=os.path.join(td, f"fleet-{tag}{n}"),
                 log=lambda m: None,
             )
             fleet.start()
@@ -1935,6 +2196,162 @@ def run_fleet_suite(
                 results["forced_kill"] = kill_row
             finally:
                 stop_fleet(fleet, server, thread)
+
+        # mixed-size phase (explicit --serve-mix only): identical seeded
+        # schedule through real workers for BOTH batching modes —
+        # per-size-class latency + each worker's padding_efficiency
+        if mix_parsed:
+            large = max(s for s, _ in mix_parsed)
+            mixed_ladder = tuple(
+                sorted({FLEET_REQUEST_WINDOWS, large})
+            )
+            schedule = _mix_schedule(mix_parsed, clients * iterations)
+            payloads = {}
+            for size, _ in mix_parsed:
+                mpos = np.zeros((size, cols, 2), np.int64)
+                for i in range(size):
+                    mpos[i, :, 0] = np.arange(
+                        i * stride, i * stride + cols
+                    )
+                mx = rng.integers(
+                    0, C.FEATURE_VOCAB, (size, rows, cols)
+                ).astype(np.uint8)
+                payloads[size] = (mpos, mx)
+            mixed_draft = "".join(
+                rng.choice(list("ACGT"), (large - 1) * stride + cols + 10)
+            )
+            n_top = max(worker_counts)
+            results["mixed"] = {
+                "mix": mix, "workers": n_top,
+                "requests": len(schedule), "modes": {},
+            }
+
+            def drive_mixed(port: int, sched):
+                work = list(sched)
+                lat: Dict[int, list] = {s: [] for s, _ in mix_parsed}
+                errors: list = []
+                lock = threading.Lock()
+
+                def one_client():
+                    client = PolishClient(
+                        f"http://127.0.0.1:{port}", timeout=300.0
+                    )
+                    while True:
+                        with lock:
+                            if not work:
+                                return
+                            size = work.pop()
+                        mpos, mx = payloads[size]
+                        t0 = time.perf_counter()
+                        try:
+                            client.polish(
+                                mixed_draft, mpos, mx, retries=8
+                            )
+                        except Exception as e:
+                            with lock:
+                                errors.append(
+                                    f"{type(e).__name__}: {e}"[:200]
+                                )
+                        else:
+                            with lock:
+                                lat[size].append(
+                                    time.perf_counter() - t0
+                                )
+
+                threads = [
+                    threading.Thread(target=one_client, daemon=True)
+                    for _ in range(clients)
+                ]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                return time.perf_counter() - t0, lat, errors
+
+            for mode in ("deadline", "continuous"):
+                mode_cfg = dataclasses.replace(
+                    cfg,
+                    serve=dataclasses.replace(
+                        cfg.serve, ladder=mixed_ladder, batching=mode
+                    ),
+                )
+                mode_cfg_path = os.path.join(td, f"worker-{mode}.json")
+                with open(mode_cfg_path, "w") as f:
+                    f.write(
+                        dataclasses.replace(
+                            mode_cfg,
+                            fleet=dataclasses.replace(
+                                mode_cfg.fleet, workers=0
+                            ),
+                        ).to_json()
+                    )
+                fleet, server, thread = start_fleet(
+                    n_top, run_cfg=mode_cfg,
+                    worker_cfg_path=mode_cfg_path, tag=f"mix-{mode}-",
+                )
+                try:
+                    port = server.server_address[1]
+
+                    def scrape_fill(port):
+                        """{worker: (windows, padded)} via the front
+                        end's per-worker counter passthrough."""
+                        import urllib.request
+
+                        with urllib.request.urlopen(
+                            f"http://127.0.0.1:{port}/metrics", timeout=10
+                        ) as r:
+                            text = r.read().decode()
+                        out: Dict[str, list] = {}
+                        for line in text.splitlines():
+                            for i, name in enumerate((
+                                "roko_serve_fill_windows_total{",
+                                "roko_serve_fill_padded_total{",
+                            )):
+                                if line.startswith(name):
+                                    wid = line.split('worker="')[1].split(
+                                        '"'
+                                    )[0]
+                                    out.setdefault(wid, [0, 0])[i] = int(
+                                        float(line.rsplit(" ", 1)[1])
+                                    )
+                        return out
+
+                    drive_mixed(  # untimed calibration, one per class
+                        port, [s for s, _ in mix_parsed]
+                    )
+                    try:
+                        fill0 = scrape_fill(port)
+                    except Exception:
+                        fill0 = {}
+                    wall, lat, errors = drive_mixed(port, schedule)
+                    row = _mixed_latency_row(wall, len(schedule), lat)
+                    row["client_errors"] = len(errors)
+                    if errors:
+                        row["errors"] = errors[:5]
+                    # padding efficiency as the serve suite measures it:
+                    # fill-counter DELTAS across the timed phase (the
+                    # lifetime ratio would fold in the heavily padded
+                    # calibration dispatches), summed over workers
+                    try:
+                        fill1 = scrape_fill(port)
+                        dw = sum(
+                            w - fill0.get(wid, [0, 0])[0]
+                            for wid, (w, _) in fill1.items()
+                        )
+                        dp_rows = sum(
+                            p - fill0.get(wid, [0, 0])[1]
+                            for wid, (_, p) in fill1.items()
+                        )
+                        if dp_rows > 0:
+                            row["padding_efficiency"] = round(
+                                dw / dp_rows, 4
+                            )
+                    except Exception:
+                        pass
+                    results["mixed"]["modes"][mode] = row
+                finally:
+                    stop_fleet(fleet, server, thread)
     return results
 
 
@@ -1996,9 +2413,22 @@ def main(argv=None) -> None:
         type=int,
         default=None,
         help="fixed-work mode: pin the timed iteration count of the "
-        "inference/train suites and the per-client request count of "
-        "the fleet suite (recorded in the artifact; ROADMAP watch "
-        "item 6)",
+        "inference/train suites, the per-client request count of the "
+        "fleet suite, and the request count of the mixed-size serve "
+        "suite (recorded in the artifact; ROADMAP watch item 6)",
+    )
+    ap.add_argument(
+        "--serve-mix",
+        default=None,
+        metavar="SIZE:PCT[,SIZE:PCT...]",
+        help="mixed-size serve workload, e.g. 4:90,256:10 (90%% "
+        "4-window / 10%% 256-window requests): drives the serve suite "
+        "A/B of both batching policies on identical fixed work "
+        "(padding_efficiency + per-size-class p50/p99) and threads the "
+        "same mix through the fleet suite; default "
+        f"{SERVE_MIX_DEFAULT_TPU} on TPU / {SERVE_MIX_DEFAULT_CPU} "
+        "elsewhere when the e2e suite runs (serve suite only); "
+        "0 disables",
     )
     ap.add_argument(
         "--input-rows",

@@ -275,6 +275,8 @@ def _build_config(args: argparse.Namespace):
         host="host", port="port", max_queue="max_queue",
         max_delay_ms="max_delay_ms", data_root="data_root",
         ladder="ladder",  # already a tuple via the _ladder_type callback
+        batching="batching", max_queue_age_ms="max_queue_age_ms",
+        rung_upgrade_fill="rung_upgrade_fill",
     )
     pipeline = over(
         base.pipeline,
@@ -1046,7 +1048,28 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-queue", type=int, default=None,
                    help="bounded request queue size (full -> 503 + Retry-After)")
     p.add_argument("--max-delay-ms", type=float, default=None,
-                   help="micro-batch deadline from first queued request")
+                   help="micro-batch deadline from first queued request "
+                   "(--batching deadline)")
+    p.add_argument(
+        "--batching", choices=["continuous", "deadline"], default=None,
+        help="batching policy (default continuous): 'continuous' packs "
+        "windows from many requests densely into each ladder-rung device "
+        "step and refills freed slots as requests complete — a small "
+        "request never waits behind a large one; 'deadline' restores the "
+        "whole-request coalescer (right for single-tenant bulk polish; "
+        "docs/SERVING.md 'Continuous batching')",
+    )
+    p.add_argument(
+        "--max-queue-age-ms", type=float, default=None,
+        help="continuous batching: oldest queued window waits at most "
+        "this before a partial batch dispatches padded (default 25)",
+    )
+    p.add_argument(
+        "--rung-upgrade-fill", type=float, default=None,
+        help="continuous batching rung-upgrade hysteresis: pad up to the "
+        "next-larger ladder rung only when pending windows fill at least "
+        "this fraction of it (default 0.75)",
+    )
     p.add_argument(
         "--data-root", default=None,
         help="confine the /polish ref+bam form to files under this "

@@ -192,6 +192,15 @@ class MeshConfig:
     sp: int = 1
 
 
+#: valid ``ServeConfig.batching`` policies: "continuous" packs windows
+#: from many requests densely into ladder-rung device steps and refills
+#: freed capacity the moment earlier requests complete (batch shape
+#: decoupled from request boundaries — serve/scheduler.py); "deadline"
+#: is the classic whole-request coalescer (serve/batcher.py), still the
+#: right call for single-tenant bulk polish (docs/SERVING.md)
+BATCHING_MODES = ("continuous", "deadline")
+
+
 @dataclass(frozen=True)
 class ServeConfig:
     """Persistent polishing service (roko_tpu/serve, docs/SERVING.md)."""
@@ -208,6 +217,24 @@ class ServeConfig:
     #: micro-batching deadline: a partially filled batch dispatches at
     #: most this long after its first request arrived
     max_delay_ms: float = 25.0
+    #: batching policy, one of BATCHING_MODES (docs/SERVING.md
+    #: "Continuous batching"): "continuous" (default) decouples device
+    #: batch shape from request boundaries — a 4-window request never
+    #: waits behind a 512-window one; "deadline" restores the
+    #: whole-request coalescer
+    batching: str = "continuous"
+    #: continuous mode: the oldest queued window waits at most this long
+    #: before a partial batch dispatches padded (the continuous analogue
+    #: of — and deliberately equal to — ``max_delay_ms``, so a lone
+    #: request's latency floor never regresses vs deadline mode; until
+    #: then the scheduler prefers waiting for arrivals or dispatching
+    #: completely FULL smaller rungs)
+    max_queue_age_ms: float = 25.0
+    #: continuous mode rung-upgrade hysteresis: pending windows pad up
+    #: to the next-larger ladder rung only when they would fill at least
+    #: this fraction of it; below that a completely full smaller rung
+    #: dispatches instead (padding efficiency over batch size)
+    rung_upgrade_fill: float = 0.75
     #: seconds a rejected client is told to wait before retrying
     retry_after_s: float = 1.0
     #: per-stage latency reservoir size backing the /metrics p50/p99 rows
@@ -217,6 +244,27 @@ class ServeConfig:
     #: directory; None = any readable path — acceptable on the default
     #: loopback bind, set this when binding beyond localhost
     data_root: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        # validate at construction (config layering, JSON load, CLI) so
+        # a typo'd policy fails where it was written, not at serve start
+        if self.batching not in BATCHING_MODES:
+            raise ValueError(
+                f"unknown batching policy {self.batching!r}; expected one "
+                "of " + "|".join(BATCHING_MODES)
+            )
+        if not 0.0 < self.rung_upgrade_fill <= 1.0:
+            raise ValueError(
+                "rung_upgrade_fill must lie in (0, 1]; got "
+                f"{self.rung_upgrade_fill}"
+            )
+        if self.max_queue_age_ms < 0:
+            # a negative age would make every scheduler cycle flush
+            # immediately — tiny padded batches, the exact waste
+            # continuous batching exists to remove
+            raise ValueError(
+                f"max_queue_age_ms must be >= 0; got {self.max_queue_age_ms}"
+            )
 
 
 @dataclass(frozen=True)

@@ -13,7 +13,10 @@ Modules:
 
 - ``session``  — warm params + shape-ladder predict dispatch, recompile-free
 - ``batcher``  — bounded-queue dynamic micro-batching with a latency
-  deadline and explicit backpressure
+  deadline and explicit backpressure (the "deadline" policy)
+- ``scheduler`` — continuous ragged batching: windows from many
+  requests packed densely into ladder-rung device steps, freed slots
+  refilled as requests complete (the default "continuous" policy)
 - ``metrics``  — Prometheus-style text counters over
   :class:`roko_tpu.utils.profiling.StageTimer`
 - ``server``   — ``ThreadingHTTPServer`` front end
@@ -29,12 +32,14 @@ from roko_tpu.serve.batcher import Backpressure, MicroBatcher
 from roko_tpu.serve.client import PolishClient, ServerBusy, ServiceUnavailable
 from roko_tpu.serve.fleet import Fleet, WorkerHandle
 from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.scheduler import ContinuousBatcher
 from roko_tpu.serve.server import drain, make_server, serve_forever
 from roko_tpu.serve.session import PolishSession
 from roko_tpu.serve.supervisor import make_front_server, run_supervisor
 
 __all__ = [
     "Backpressure",
+    "ContinuousBatcher",
     "Fleet",
     "MicroBatcher",
     "PolishClient",

@@ -69,13 +69,22 @@ class PredictFuture:
         if self._req.error is not None:
             raise self._req.error
         if self._metrics is not None:
-            self._metrics.timer.record(
-                "request", time.perf_counter() - self._req.t_submit
+            # records the aggregate "request" span AND the request's
+            # size-class span (ladder-rung buckets) so /metrics can show
+            # small-request p99 beside large-request p99 — the
+            # head-of-line-blocking signal continuous batching exists
+            # to fix (docs/SERVING.md "Continuous batching")
+            self._metrics.observe_request(
+                len(self._req.x), time.perf_counter() - self._req.t_submit
             )
         return self._req.preds
 
 
 class MicroBatcher:
+    #: policy name reported in /healthz (``ServeConfig.batching`` value
+    #: that selects this class in ``make_server``)
+    BATCHING_MODE = "deadline"
+
     def __init__(
         self,
         session: PolishSession,

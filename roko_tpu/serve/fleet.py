@@ -76,10 +76,17 @@ STATE_CODES = {
 }
 
 #: worker series re-exported at the front end labeled by worker id
-#: (ISSUE satellite: compile-cache + breaker gauges per worker)
+#: (breaker + compile-cache gauges, plus the continuous scheduler's
+#: occupancy/padding series so fleet dashboards see per-worker packing
+#: density — docs/SERVING.md "Continuous batching")
 PASSTHROUGH_SERIES = (
     ("roko_serve_breaker_state", "gauge"),
     ("roko_serve_breaker_trips_total", "counter"),
+    ("roko_serve_padding_efficiency", "gauge"),
+    ("roko_serve_fill_windows_total", "counter"),
+    ("roko_serve_fill_padded_total", "counter"),
+    ("roko_serve_queue_windows", "gauge"),
+    ("roko_serve_scheduler_occupancy", "gauge"),
     ("roko_compile_cache_hits", "counter"),
     ("roko_compile_cache_misses", "counter"),
 )

@@ -16,8 +16,19 @@ Exposed series (all prefixed ``roko_serve_``):
 - ``batch_fill_ratio`` — gauge, windows dispatched / padded rows over
   the service lifetime (how much of each padded device batch was real
   work);
+- ``padding_efficiency`` — gauge, the same ratio under the ISSUE's name
+  (real windows ÷ rung×steps): the number the continuous scheduler
+  exists to push toward 1.0, reported identically for both batching
+  modes so the bench serve suite compares them on one series;
+- ``queue_windows`` / ``scheduler_occupancy`` — gauges, queued-window
+  backlog and backlog ÷ top rung (continuous mode; absent under the
+  deadline batcher, which schedules whole requests);
 - ``request_latency_seconds{quantile="0.5"|"0.99"}`` + ``_count`` /
-  ``_sum`` — summary over the retained sample window;
+  ``_sum`` — summary over the retained sample window, plus per
+  size-class rows labeled ``size_class="le{rung}"`` (the ladder rung
+  the request's window count buckets into; ``gt{top}`` past the top
+  rung) once ``size_classes`` is set — small-request p99 beside
+  large-request p99 is the head-of-line-blocking signal;
 - ``breaker_state`` — gauge, 0 closed / 1 half-open / 2 open — and
   ``breaker_trips_total`` — counter — when a
   :class:`roko_tpu.resilience.CircuitBreaker` is attached
@@ -35,7 +46,7 @@ persistent-compilation-cache counters from :mod:`roko_tpu.compile`.
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 from roko_tpu.compile.cache import cache_counters
 from roko_tpu.utils.profiling import StageTimer
@@ -79,6 +90,30 @@ class ServeMetrics:
         #: ladder warmup wall seconds (set once warmup finishes; None
         #: renders NaN — "still warming")
         self.warmup_seconds: Optional[float] = None
+        #: request-size latency buckets (the session's ladder rungs, set
+        #: by make_server); empty = per-class latency rows disabled
+        self.size_classes: Tuple[int, ...] = ()
+        #: continuous-scheduler gauges (set by ContinuousBatcher; None =
+        #: deadline mode, the series are simply absent)
+        self.queue_windows: Optional[Callable[[], int]] = None
+        self.occupancy: Optional[Callable[[], float]] = None
+
+    def size_class(self, windows: int) -> str:
+        """Ladder-rung bucket label for an n-window request: ``le{r}``
+        for the smallest rung r >= n, ``gt{top}`` past the top rung."""
+        for rung in self.size_classes:
+            if windows <= rung:
+                return f"le{rung}"
+        return f"gt{self.size_classes[-1]}"
+
+    def observe_request(self, windows: int, seconds: float) -> None:
+        """One completed request: the aggregate latency span plus its
+        size-class span (PredictFuture.result calls this for both
+        batching modes, so the per-class p50/p99 comparison is
+        apples-to-apples)."""
+        self.timer.record("request", seconds)
+        if self.size_classes:
+            self.timer.record(f"request:{self.size_class(windows)}", seconds)
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -95,6 +130,14 @@ class ServeMetrics:
                 return None
             return self._fill_windows / self._fill_padded
 
+    def fill_totals(self) -> "Tuple[int, int]":
+        """(real windows, padded rows) dispatched so far — the bench
+        serve suite snapshots this around its untimed calibration phase
+        so calibration dispatches can't skew the reported
+        padding_efficiency."""
+        with self._lock:
+            return self._fill_windows, self._fill_padded
+
     def render(self) -> str:
         """The ``GET /metrics`` body."""
         lines = []
@@ -110,6 +153,28 @@ class ServeMetrics:
             f"{_PREFIX}batch_fill_ratio "
             + ("NaN" if fill is None else f"{fill:.4f}")
         )
+        # the ISSUE's name for the same ratio (real windows / rung*steps)
+        lines.append(f"# TYPE {_PREFIX}padding_efficiency gauge")
+        lines.append(
+            f"{_PREFIX}padding_efficiency "
+            + ("NaN" if fill is None else f"{fill:.4f}")
+        )
+        # the raw numerator/denominator behind the ratio, so a scraper
+        # (the bench fleet mixed phase) can DIFF them around a warm-up
+        # window instead of settling for the lifetime ratio
+        fw, fp = self.fill_totals()
+        lines.append(f"# TYPE {_PREFIX}fill_windows_total counter")
+        lines.append(f"{_PREFIX}fill_windows_total {fw}")
+        lines.append(f"# TYPE {_PREFIX}fill_padded_total counter")
+        lines.append(f"{_PREFIX}fill_padded_total {fp}")
+        if self.queue_windows is not None:
+            lines.append(f"# TYPE {_PREFIX}queue_windows gauge")
+            lines.append(f"{_PREFIX}queue_windows {int(self.queue_windows())}")
+        if self.occupancy is not None:
+            lines.append(f"# TYPE {_PREFIX}scheduler_occupancy gauge")
+            lines.append(
+                f"{_PREFIX}scheduler_occupancy {self.occupancy():.4f}"
+            )
         lines.append(f"# TYPE {_PREFIX}cpu_fallback gauge")
         lines.append(f"{_PREFIX}cpu_fallback {int(bool(self.cpu_fallback()))}")
         if self.breaker is not None:
@@ -138,4 +203,29 @@ class ServeMetrics:
                 lines.append(f'{lat}{{quantile="0.{q}"}} {v:.6f}')
         lines.append(f"{lat}_count {self.timer.counts.get('request', 0)}")
         lines.append(f"{lat}_sum {self.timer.totals.get('request', 0.0):.6f}")
+        # per-size-class rows (only classes that have seen traffic): the
+        # small-vs-large latency split that makes head-of-line blocking
+        # visible from a dashboard
+        for rung in self.size_classes:
+            for label in (f"le{rung}",) + (
+                (f"gt{rung}",) if rung == self.size_classes[-1] else ()
+            ):
+                stage = f"request:{label}"
+                if not self.timer.counts.get(stage):
+                    continue
+                for q in (50, 99):
+                    v = self.timer.percentile(stage, q)
+                    if v is not None:
+                        lines.append(
+                            f'{lat}{{quantile="0.{q}",size_class="{label}"}}'
+                            f" {v:.6f}"
+                        )
+                lines.append(
+                    f'{lat}_count{{size_class="{label}"}} '
+                    f"{self.timer.counts[stage]}"
+                )
+                lines.append(
+                    f'{lat}_sum{{size_class="{label}"}} '
+                    f"{self.timer.totals.get(stage, 0.0):.6f}"
+                )
         return "\n".join(lines) + "\n"

@@ -1,0 +1,431 @@
+"""Continuous ragged batching: device batch shape decoupled from
+request boundaries (docs/SERVING.md "Continuous batching").
+
+The deadline coalescer (``serve/batcher.py``) batches at REQUEST
+granularity: a request's windows travel together, so a 4-window request
+behind a 512-window one waits for the whole large dispatch (head-of-line
+blocking), and a partial batch pads all the way up to the next ladder
+rung (device cycles burned on zeros). :class:`ContinuousBatcher` takes
+the TPU-native idiom from Ragged Paged Attention (PAPERS.md): treat the
+precompiled ladder rungs as a rolling pool of WINDOW SLOTS, pack windows
+from many requests densely into each device step via a per-request
+segment vector, and slot newly arrived requests into freed capacity the
+moment earlier requests' windows complete — requests finish
+incrementally across steps, and batch shape is whatever keeps the rungs
+full.
+
+Scheduling policy, applied each cycle over the queued-window backlog:
+
+1. **full top rung** — backlog >= the top rung dispatches a completely
+   full top-rung batch (the steady-state path; zero padding);
+2. **exact/near fit** — otherwise the backlog pads to the smallest rung
+   that fits, but ONLY when it fills at least ``rung_upgrade_fill`` of
+   it (rung-upgrade hysteresis — padding efficiency over batch size);
+3. **full smaller rung** — else, if a smaller rung can be filled
+   COMPLETELY, dispatch that and leave the remainder queued (its age
+   keeps counting);
+4. **age flush** — else wait for arrivals until the oldest queued window
+   is ``max_queue_age_ms`` old, then dispatch padded (latency floor for
+   sparse traffic, the continuous analogue of ``max_delay_ms``).
+
+Slots inside a step are granted FAIR-SHARE over requests in arrival
+order: every request with unpacked windows gets ~k/active slots per
+step, so a small request entering while a huge one is mid-flight packs
+into the very next step, and a huge request under a sustained stream of
+small ones still progresses every step — starvation-free in both
+directions (tests/test_scheduler.py pins both).
+
+All dispatches go through ``PolishSession.predict``, so only ladder
+shapes ever reach the device — the zero-steady-state-recompile contract
+is untouched. Backpressure is explicit (:class:`Backpressure`, mapped
+to 503 by the HTTP layer) with a ``Retry-After`` computed from the live
+backlog and the scheduler's observed windows/sec — not the deadline
+batcher's fixed queue-drain guess (ISSUE satellite; the same stale-hint
+failure shape PR 4 fixed for warming).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from roko_tpu.resilience import CircuitBreaker
+from roko_tpu.serve.batcher import (
+    _REQUEST_ERRORS,
+    Backpressure,
+    PredictFuture,
+)
+from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.session import PolishSession
+
+#: Retry-After clamp for the computed hint: never promise a sub-100 ms
+#: poll loop, never more than the breaker-reset order of magnitude
+_RETRY_AFTER_MIN_S = 0.1
+_RETRY_AFTER_MAX_S = 30.0
+
+#: EMA decay for the observed dispatch throughput (windows/sec) behind
+#: the Retry-After estimate — a few dispatches of history, quick to
+#: track load shifts
+_THROUGHPUT_BETA = 0.7
+
+
+class _Slot:
+    """One submitted request riding the slot pool: its windows, the
+    incrementally filled prediction buffer, and pack/fill cursors.
+    ``next`` advances as windows are packed into device steps (may take
+    many steps); ``filled`` as their predictions scatter back. The
+    future resolves when every window is filled."""
+
+    __slots__ = ("x", "preds", "next", "filled", "done", "error", "t_submit")
+
+    def __init__(self, x: np.ndarray):
+        self.x = x
+        self.preds = np.empty((x.shape[0], x.shape[2]), np.int32)
+        self.next = 0       # windows handed to a device step so far
+        self.filled = 0     # windows whose predictions are back
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.perf_counter()
+
+    @property
+    def n(self) -> int:
+        return self.x.shape[0]
+
+
+#: a planned device step: (slot, request-window offset, count, batch
+#: offset) spans — the per-request segment/index vector of one packed
+#: batch
+Span = Tuple[_Slot, int, int, int]
+
+
+class ContinuousBatcher:
+    """Drop-in alternative to :class:`~roko_tpu.serve.batcher.
+    MicroBatcher` (same ``submit``/``predict``/``stop`` surface, same
+    :class:`Backpressure`/:class:`PredictFuture` types) scheduling at
+    WINDOW granularity instead of request granularity."""
+
+    #: policy name reported in /healthz (``ServeConfig.batching`` value
+    #: that selects this class in ``make_server``)
+    BATCHING_MODE = "continuous"
+
+    def __init__(
+        self,
+        session: PolishSession,
+        *,
+        max_queue: Optional[int] = None,
+        max_queue_age_ms: Optional[float] = None,
+        rung_upgrade_fill: Optional[float] = None,
+        retry_after_s: Optional[float] = None,
+        metrics: Optional[ServeMetrics] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        start: bool = True,
+    ):
+        serve_cfg = session.cfg.serve
+        self.session = session
+        self.breaker = breaker
+        self.metrics = metrics
+        self.max_queue = serve_cfg.max_queue if max_queue is None else max_queue
+        self.max_queue_age_s = (
+            serve_cfg.max_queue_age_ms
+            if max_queue_age_ms is None
+            else max_queue_age_ms
+        ) / 1e3
+        self.rung_upgrade_fill = (
+            serve_cfg.rung_upgrade_fill
+            if rung_upgrade_fill is None
+            else rung_upgrade_fill
+        )
+        #: static floor for the Retry-After hint, used verbatim until the
+        #: first dispatch teaches the scheduler its throughput
+        self.base_retry_after_s = (
+            serve_cfg.retry_after_s if retry_after_s is None else retry_after_s
+        )
+        #: requests with windows not yet packed into a device step,
+        #: arrival order (the admission bound counts THESE — a fully
+        #: packed request occupies device steps, not queue capacity)
+        self._pool: List[_Slot] = []
+        self._cv = threading.Condition()
+        self._running = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        #: reusable top-rung slot slab: spans copy into it densely each
+        #: step, so steady state allocates nothing per dispatch
+        self._slab: Optional[np.ndarray] = None
+        # derived from config, not the session's private attribute, so
+        # session stand-ins (tests, tools) need only carry a cfg
+        w = session.cfg.model
+        self._window_shape = getattr(
+            session, "_window_shape", (w.window_rows, w.window_cols)
+        )
+        self._ema_wps: Optional[float] = None
+        if metrics is not None:
+            metrics.queue_depth = lambda: len(self._pool)
+            metrics.queue_windows = self.backlog_windows
+            metrics.occupancy = self.occupancy
+        if start:
+            self.start()
+
+    # -- observation ---------------------------------------------------------
+
+    def backlog_windows(self) -> int:
+        """Windows queued but not yet packed into a device step."""
+        with self._cv:
+            return sum(s.n - s.next for s in self._pool)
+
+    def occupancy(self) -> float:
+        """Queued-window backlog as a fraction of one top-rung step —
+        instantaneous demand vs one step of device capacity (the
+        ``roko_serve_scheduler_occupancy`` gauge; >1 means the next
+        step is already oversubscribed)."""
+        return self.backlog_windows() / self.session.ladder[-1]
+
+    @property
+    def retry_after_s(self) -> float:
+        """Live Retry-After hint: the queued backlog divided by the
+        observed dispatch throughput (EMA windows/sec), clamped — a
+        rejected client is told when capacity will actually free up,
+        not the deadline batcher's fixed 1 s queue-drain guess. Before
+        any dispatch has calibrated the throughput, the configured
+        static value is all there is."""
+        with self._cv:
+            backlog = sum(s.n - s.next for s in self._pool)
+            wps = self._ema_wps
+        if not wps or wps <= 0:
+            return self.base_retry_after_s
+        # +1 top rung: even an empty queue waits out the step in flight
+        est = (backlog + self.session.ladder[-1]) / wps
+        return min(_RETRY_AFTER_MAX_S, max(_RETRY_AFTER_MIN_S, est))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, name="roko-continuous-batcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Stop the scheduler: the worker finishes the device step in
+        flight (its windows scatter back), then every request that is
+        not yet complete — queued OR mid-flight across steps — fails
+        loudly with "batcher stopped" instead of stranding its future.
+        The server's graceful drain orders this AFTER the in-flight
+        HTTP handlers finish, so a clean drain never hits the failure
+        path (docs/SERVING.md "Failure handling")."""
+        with self._cv:
+            self._stopped = True
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        self._fail_incomplete()
+
+    def _fail_incomplete(self) -> None:
+        with self._cv:
+            pool, self._pool = self._pool, []
+        for slot in pool:
+            if not slot.done.is_set():
+                slot.error = RuntimeError("batcher stopped")
+                slot.done.set()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, x: np.ndarray) -> PredictFuture:
+        """Admit one window batch into the slot pool; raises
+        :class:`Backpressure` (with the computed Retry-After) when the
+        pool is at capacity and ``ValueError`` on bad window geometry —
+        validated HERE so a malformed request can never poison the
+        shared device step it would have been packed into (the deadline
+        batcher fails a whole coalesced batch on one bad member; dense
+        packing must not)."""
+        if self._stopped:
+            raise RuntimeError("batcher stopped")
+        x = np.ascontiguousarray(x, dtype=np.uint8)
+        if x.ndim != 3 or x.shape[1:] != self._window_shape:
+            raise ValueError(
+                f"windows shaped {x.shape}, want (n,) + "
+                f"{self._window_shape}"
+            )
+        slot = _Slot(x)
+        if slot.n == 0:
+            # nothing to schedule: complete immediately (the empty reply
+            # is still well-formed). Decided BEFORE the breaker check —
+            # a dispatch-free request must never claim (and then leak)
+            # the breaker's single half-open probe slot.
+            slot.done.set()
+            if self.metrics is not None:
+                self.metrics.inc("requests")
+            return PredictFuture(slot, self.metrics)
+        if self.breaker is not None and not self.breaker.allow():
+            if self.metrics is not None:
+                self.metrics.inc("rejected")
+            raise Backpressure(
+                max(self.breaker.retry_after_s(), self.base_retry_after_s),
+                reason="circuit breaker open (device failing)",
+            )
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("batcher stopped")
+            if len(self._pool) >= self.max_queue:
+                if self.breaker is not None:
+                    # a half-open allow() claimed the probe slot for a
+                    # request that never made it in — release it
+                    self.breaker.cancel_probe()
+                if self.metrics is not None:
+                    self.metrics.inc("rejected")
+                raise Backpressure(self.retry_after_s)
+            self._pool.append(slot)
+            self._cv.notify()
+        if self.metrics is not None:
+            self.metrics.inc("requests")
+            self.metrics.inc("windows", slot.n)
+        return PredictFuture(slot, self.metrics)
+
+    def predict(
+        self, x: np.ndarray, timeout: Optional[float] = None
+    ) -> np.ndarray:
+        """submit + result in one call (the HTTP handler's path)."""
+        return self.submit(x).result(timeout)
+
+    # -- scheduling ----------------------------------------------------------
+
+    def _plan(self, now: float) -> Tuple[Optional[int], Optional[float]]:
+        """Decide this cycle's dispatch size under the lock. Returns
+        ``(k, wait)``: ``k`` windows to pack now (None = nothing yet),
+        ``wait`` seconds to sleep for arrivals (None = until woken).
+        Policy steps 1-4 of the module docstring."""
+        pending = sum(s.n - s.next for s in self._pool)
+        if pending == 0:
+            return None, None
+        ladder = self.session.ladder
+        top = ladder[-1]
+        if pending >= top:
+            return top, None
+        fit = self.session.rung_for(pending)
+        if pending == fit or pending >= self.rung_upgrade_fill * fit:
+            # exact fit, or close enough that upgrading to the larger
+            # rung beats splitting (hysteresis knob)
+            return pending, None
+        full = max((r for r in ladder if r <= pending), default=None)
+        if full is not None:
+            # a completely full smaller rung: dispatch it, remainder
+            # stays queued with its age intact
+            return full, None
+        oldest = min(s.t_submit for s in self._pool if s.next < s.n)
+        age_left = self.max_queue_age_s - (now - oldest)
+        if age_left <= 0:
+            return pending, None  # age flush: pad rather than wait more
+        return None, age_left
+
+    def _take(self, k: int) -> List[Span]:
+        """Pack ``k`` window slots from the pool under the lock —
+        fair-share over requests in arrival order (repeated rounds of
+        ~k/active each until the slots are spent), adjacent spans of
+        one request merged. Exhausted requests leave the pool; they
+        complete when their scattered predictions arrive."""
+        spans: List[Span] = []
+        off = 0
+        while off < k:
+            live = [s for s in self._pool if s.next < s.n]
+            if not live:
+                break
+            share = max(1, (k - off) // len(live))
+            for slot in live:
+                take = min(share, slot.n - slot.next, k - off)
+                if take <= 0:
+                    continue
+                if spans and spans[-1][0] is slot and (
+                    spans[-1][1] + spans[-1][2] == slot.next
+                ):
+                    prev = spans[-1]
+                    spans[-1] = (slot, prev[1], prev[2] + take, prev[3])
+                else:
+                    spans.append((slot, slot.next, take, off))
+                slot.next += take
+                off += take
+                if off >= k:
+                    break
+        self._pool = [s for s in self._pool if s.next < s.n]
+        return spans
+
+    def _dispatch(self, spans: List[Span]) -> None:
+        """One packed device step: copy spans densely into the slot
+        slab, predict (``PolishSession`` pads to the ladder — only
+        precompiled shapes reach the device), scatter predictions back
+        per segment, and resolve every request whose last window just
+        landed (freed capacity is re-packed next cycle)."""
+        total = sum(c for _, _, c, _ in spans)
+        if total == 0:
+            return
+        if self._slab is None:
+            self._slab = np.empty(
+                (self.session.ladder[-1],) + self._window_shape, np.uint8
+            )
+        for slot, src, count, off in spans:
+            self._slab[off : off + count] = slot.x[src : src + count]
+        t0 = time.perf_counter()
+        try:
+            preds = self.session.predict(self._slab[:total])
+        except BaseException as e:
+            if self.breaker is not None:
+                if isinstance(e, _REQUEST_ERRORS):
+                    # submit() validated geometry, so a request-shaped
+                    # error here is session misuse, not device illness
+                    self.breaker.cancel_probe()
+                else:
+                    self.breaker.record_failure()
+            # fail every request with windows in this step (their other
+            # windows may have completed in earlier steps; the error
+            # wins) and drop their remainders from the pool
+            failed = {id(s) for s, _, _, _ in spans}
+            with self._cv:
+                self._pool = [
+                    s for s in self._pool if id(s) not in failed
+                ]
+            for slot, _, _, _ in spans:
+                if not slot.done.is_set():
+                    slot.error = e
+                    slot.done.set()
+            return
+        dt = time.perf_counter() - t0
+        if self.breaker is not None:
+            self.breaker.record_success()
+        for slot, src, count, off in spans:
+            slot.preds[src : src + count] = preds[off : off + count]
+            slot.filled += count
+            if slot.filled == slot.n:
+                slot.done.set()
+        with self._cv:
+            wps = total / max(dt, 1e-6)
+            self._ema_wps = (
+                wps
+                if self._ema_wps is None
+                else _THROUGHPUT_BETA * self._ema_wps
+                + (1 - _THROUGHPUT_BETA) * wps
+            )
+        if self.metrics is not None:
+            self.metrics.inc("batches")
+            self.metrics.observe_fill(
+                total, max(1, self.session.padded_size(total))
+            )
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                spans: Optional[List[Span]] = None
+                while self._running:
+                    k, wait = self._plan(time.perf_counter())
+                    if k is not None:
+                        spans = self._take(k)
+                        break
+                    self._cv.wait(wait)
+                if spans is None:  # stopped
+                    return
+            self._dispatch(spans)

@@ -341,6 +341,13 @@ class _Handler(JsonRequestHandler):
             body: Dict[str, Any] = {
                 "status": "ok",
                 "ladder": list(session.ladder),
+                # which batching policy is live (docs/SERVING.md
+                # "Continuous batching") — derived from the batcher
+                # actually serving, not the config, so an explicitly
+                # passed batcher reports truthfully
+                "batching": getattr(
+                    self.batcher, "BATCHING_MODE", "deadline"
+                ),
                 "compiled": session.cache_size(),
                 # degraded-but-serving: a device hang permanently failed
                 # this session over to host-CPU predict (getattr:
@@ -481,23 +488,42 @@ def make_server(
     serve_cfg = serve_cfg or session.cfg.serve
     rcfg = session.cfg.resilience
     metrics = metrics or ServeMetrics(latency_samples=serve_cfg.latency_samples)
+    # per-size-class latency buckets follow the session's ladder rungs
+    metrics.size_classes = tuple(session.ladder)
     if batcher is None:
         if breaker is None and rcfg.breaker_failures > 0:
             breaker = CircuitBreaker(
                 failure_threshold=rcfg.breaker_failures,
                 reset_s=rcfg.breaker_reset_s,
             )
-        # the default batcher takes its knobs from the EXPLICIT
-        # serve_cfg — MicroBatcher's own defaults read session.cfg.serve,
-        # which may be a different config object than the one passed here
-        batcher = MicroBatcher(
-            session,
-            metrics=metrics,
-            breaker=breaker,
-            max_queue=serve_cfg.max_queue,
-            max_delay_ms=serve_cfg.max_delay_ms,
-            retry_after_s=serve_cfg.retry_after_s,
-        )
+        # batching policy is pluggable (ServeConfig.batching,
+        # docs/SERVING.md "Continuous batching"): the continuous
+        # scheduler packs windows from many requests densely into each
+        # ladder-rung step; "deadline" restores the whole-request
+        # coalescer. Knobs come from the EXPLICIT serve_cfg — the
+        # batchers' own defaults read session.cfg.serve, which may be a
+        # different config object than the one passed here.
+        if serve_cfg.batching == "continuous":
+            from roko_tpu.serve.scheduler import ContinuousBatcher
+
+            batcher = ContinuousBatcher(
+                session,
+                metrics=metrics,
+                breaker=breaker,
+                max_queue=serve_cfg.max_queue,
+                max_queue_age_ms=serve_cfg.max_queue_age_ms,
+                rung_upgrade_fill=serve_cfg.rung_upgrade_fill,
+                retry_after_s=serve_cfg.retry_after_s,
+            )
+        else:
+            batcher = MicroBatcher(
+                session,
+                metrics=metrics,
+                breaker=breaker,
+                max_queue=serve_cfg.max_queue,
+                max_delay_ms=serve_cfg.max_delay_ms,
+                retry_after_s=serve_cfg.retry_after_s,
+            )
     else:
         breaker = breaker or batcher.breaker
     metrics.breaker = breaker

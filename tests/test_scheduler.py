@@ -1,0 +1,660 @@
+"""Continuous ragged batching scheduler tests (roko_tpu/serve/
+scheduler.py, docs/SERVING.md "Continuous batching").
+
+Scheduling-policy units (rung selection, rung-upgrade hysteresis, age
+flush, fair-share packing, slot refill, starvation freedom both ways,
+drain with in-flight slots, dynamic Retry-After) drive a jax-free fake
+session synchronously — no timing races. The acceptance gates run the
+real stack: continuous-mode HTTP replies byte-identical to the deadline
+batcher AND to ``infer.run_inference`` (the batch ``roko-tpu
+inference`` path) on the same windows/params, with zero steady-state
+recompiles across mixed request sizes. The ``slow`` test drives mixed
+traffic against a real 2-worker fleet (ISSUE satellite: zero client
+errors)."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    RokoConfig,
+    ServeConfig,
+)
+from roko_tpu.data.hdf5 import DataWriter
+from roko_tpu.infer import run_inference
+from roko_tpu.models.model import RokoModel
+from roko_tpu.serve import (
+    Backpressure,
+    ContinuousBatcher,
+    MicroBatcher,
+    PolishClient,
+    PolishSession,
+    ServeMetrics,
+    make_server,
+)
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+CFG = RokoConfig(
+    model=TINY,
+    mesh=MeshConfig(dp=8),
+    serve=ServeConfig(ladder=(8, 16), max_delay_ms=20.0, max_queue=8),
+)
+
+ROWS, COLS = 200, 90
+
+
+class FakeSession:
+    """Ladder arithmetic + deterministic 'predict' without a device:
+    the scheduling-policy units exercise packing order, not the model.
+    predict(x)[i] is a pure function of window i's bytes, so scattered
+    results prove which window landed where."""
+
+    def __init__(self, ladder=(8, 16)):
+        self.ladder = tuple(ladder)
+        self.cfg = RokoConfig(serve=ServeConfig(ladder=self.ladder))
+        self._window_shape = (ROWS, COLS)
+        self.dispatched = []  # batch size of every predict call
+
+    def rung_for(self, n):
+        for r in self.ladder:
+            if n <= r:
+                return r
+        return self.ladder[-1]
+
+    def padded_size(self, n):
+        top = self.ladder[-1]
+        full, rest = divmod(n, top)
+        return full * top + (self.rung_for(rest) if rest else 0)
+
+    def predict(self, x):
+        self.dispatched.append(x.shape[0])
+        return x.sum(axis=1, dtype=np.int64).astype(np.int32)
+
+
+def _win(rng, n):
+    return rng.integers(0, C.FEATURE_VOCAB, (n, ROWS, COLS)).astype(np.uint8)
+
+
+def make_cb(session=None, **kw):
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("max_queue_age_ms", 50.0)
+    kw.setdefault("rung_upgrade_fill", 0.75)
+    kw.setdefault("retry_after_s", 1.0)
+    kw.setdefault("start", False)
+    return ContinuousBatcher(session or FakeSession(), **kw)
+
+
+def step(cb):
+    """Drive one scheduler cycle synchronously (plan -> take ->
+    dispatch); returns the spans it packed (None = nothing ready)."""
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter())
+        spans = cb._take(k) if k is not None else None
+    if spans:
+        cb._dispatch(spans)
+    return spans
+
+
+# -- scheduling policy units -------------------------------------------------
+
+
+def test_plan_full_top_rung(rng):
+    cb = make_cb()
+    cb.submit(_win(rng, 40))
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter())
+    assert k == 16  # backlog >= top rung: completely full top-rung step
+
+
+def test_plan_rung_upgrade_hysteresis(rng):
+    # pending 9 with ladder (8,16), upgrade_fill 0.75: 9 < 12 would
+    # waste 7/16 of the larger rung — dispatch the full 8-rung instead
+    cb = make_cb()
+    cb.submit(_win(rng, 9))
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter())
+    assert k == 8
+    # pending 13 >= 0.75 * 16: the upgrade is worth it
+    cb2 = make_cb()
+    cb2.submit(_win(rng, 13))
+    with cb2._cv:
+        k, _ = cb2._plan(time.perf_counter())
+    assert k == 13
+
+
+def test_plan_waits_then_age_flushes_small_backlog(rng):
+    cb = make_cb(max_queue_age_ms=30.0)
+    cb.submit(_win(rng, 3))
+    with cb._cv:
+        k, wait = cb._plan(time.perf_counter())
+    assert k is None  # 3 < 0.75*8: wait for arrivals...
+    assert 0 < wait <= 0.030
+    with cb._cv:  # ...but only until the oldest window is 30 ms old
+        k, _ = cb._plan(time.perf_counter() + 0.040)
+    assert k == 3  # age flush: pad 3 -> 8 rather than wait longer
+
+
+def test_take_fair_share_small_packs_with_large(rng):
+    """Dense packing: one step carries windows from BOTH a large and a
+    small request (fair share), and the small one is fully covered."""
+    cb = make_cb()
+    large = cb.submit(_win(rng, 20))
+    small = cb.submit(_win(rng, 2))
+    spans = step(cb)  # pending 22 -> one full top-rung (16) step
+    owners = [s.n for s, _, _, _ in spans]
+    assert 2 in owners and 20 in owners  # both requests in one step
+    assert small._req.filled == 2 and small._req.done.is_set()
+    assert not large._req.done.is_set()  # large continues next step
+    step(cb)
+    assert large._req.done.is_set()
+
+
+def test_packing_results_scatter_correctly(rng):
+    """Each request's result equals a solo predict of its own windows —
+    packing/scattering moves windows, never mixes them."""
+    fake = FakeSession()
+    cb = make_cb(fake)
+    xs = [_win(rng, n) for n in (5, 11, 2, 16, 1)]
+    futs = [cb.submit(x) for x in xs]
+    for _ in range(10):
+        if all(f._req.done.is_set() for f in futs):
+            break
+        if step(cb) is None:
+            # sub-rung tail: force the age flush deterministically
+            with cb._cv:
+                k, _ = cb._plan(time.perf_counter() + 1.0)
+                spans = cb._take(k) if k else None
+            if spans:
+                cb._dispatch(spans)
+    for x, f in zip(xs, futs):
+        np.testing.assert_array_equal(
+            f.result(0), x.sum(axis=1, dtype=np.int64).astype(np.int32)
+        )
+
+
+def test_slot_refill_small_never_waits_behind_large(rng):
+    """Head-of-line: a small request arriving while a large one is
+    mid-flight packs into the very next step and completes while the
+    large request is still going."""
+    cb = make_cb()
+    large = cb.submit(_win(rng, 48))  # 3 full top-rung steps
+    step(cb)  # large underway
+    small = cb.submit(_win(rng, 2))  # arrives mid-flight
+    step(cb)  # freed capacity refills: small rides this step
+    assert small._req.done.is_set()
+    assert not large._req.done.is_set()
+    while not large._req.done.is_set():
+        # the sub-rung tail waits out max_queue_age for arrivals; an
+        # advanced clock forces the age flush deterministically
+        with cb._cv:
+            k, _ = cb._plan(time.perf_counter() + 1.0)
+            spans = cb._take(k) if k is not None else None
+        assert spans is not None
+        cb._dispatch(spans)
+    assert large.result(0).shape == (48, COLS)
+
+
+def test_sustained_large_stream_does_not_starve_small(rng):
+    """A small request submitted into a sustained stream of large ones
+    completes within one step of its arrival (fair share, arrival
+    order) — the starvation/fairness gate."""
+    cb = make_cb(max_queue=64)
+    for _ in range(4):
+        cb.submit(_win(rng, 16))
+    step(cb)
+    small = cb.submit(_win(rng, 2))  # behind 3+ queued large requests
+    cb.submit(_win(rng, 16))  # the stream keeps coming
+    for n_steps in range(1, 4):
+        step(cb)
+        if small._req.done.is_set():
+            break
+    assert small._req.done.is_set() and n_steps <= 2
+
+
+def test_sustained_small_stream_does_not_starve_large(rng):
+    """The inverse: a large request keeps receiving its fair share of
+    every step while small requests stream past it."""
+    cb = make_cb(max_queue=64)
+    large = cb.submit(_win(rng, 32))
+    for _ in range(12):
+        cb.submit(_win(rng, 2))
+        step(cb)
+        if large._req.done.is_set():
+            break
+    assert large._req.done.is_set()
+
+
+def test_drain_with_inflight_slots_fails_loudly(rng):
+    """stop() mid-request: windows already dispatched have scattered,
+    but an incomplete request's future raises instead of hanging (and
+    a COMPLETED one keeps its result)."""
+    cb = make_cb()
+    done = cb.submit(_win(rng, 8))
+    step(cb)
+    assert done._req.done.is_set()
+    partial = cb.submit(_win(rng, 48))
+    step(cb)  # 16 of 48 windows through: in-flight slots exist
+    assert 0 < partial._req.filled < 48
+    cb.stop()
+    with pytest.raises(RuntimeError, match="batcher stopped"):
+        partial.result(0)
+    assert done.result(0).shape == (8, COLS)  # pre-drain result survives
+    with pytest.raises(RuntimeError, match="batcher stopped"):
+        cb.submit(_win(rng, 1))
+
+
+def test_submit_validates_geometry_without_poisoning_pool(rng):
+    """Bad geometry fails the SUBMITTER synchronously — it can never be
+    packed into (and fail) a shared device step, unlike the deadline
+    batcher's whole-coalesced-batch failure mode."""
+    cb = make_cb()
+    ok = cb.submit(_win(rng, 4))
+    with pytest.raises(ValueError, match="windows shaped"):
+        cb.submit(np.zeros((2, 10, 10), np.uint8))
+    with cb._cv:
+        assert len(cb._pool) == 1  # only the good request queued
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter() + 1.0)
+        spans = cb._take(k)
+    cb._dispatch(spans)
+    assert ok._req.done.is_set() and ok._req.error is None
+
+
+def test_device_error_fails_packed_requests_only(rng):
+    """A device-shaped failure fails every request with windows in the
+    broken step and clears their remainders; the next submission works."""
+
+    class Sick(FakeSession):
+        def __init__(self):
+            super().__init__()
+            self.boom = True
+
+        def predict(self, x):
+            if self.boom:
+                self.boom = False
+                raise RuntimeError("XLA ate it")
+            return super().predict(x)
+
+    cb = make_cb(Sick())
+    a, b = cb.submit(_win(rng, 6)), cb.submit(_win(rng, 2))
+    step(cb)
+    for f in (a, b):
+        with pytest.raises(RuntimeError, match="XLA ate it"):
+            f.result(0)
+    with cb._cv:
+        assert cb._pool == []  # no zombie remainders
+    c = cb.submit(_win(rng, 8))
+    step(cb)
+    assert c.result(0).shape == (8, COLS)
+
+
+def test_zero_window_request_never_leaks_halfopen_probe(rng):
+    """An n=0 request completes without a dispatch, so it must never
+    claim the breaker's single half-open probe slot — leaking it would
+    wedge the server into 503s until restart (the dispatch is what
+    records success/failure and releases the probe)."""
+    from roko_tpu.resilience import CircuitBreaker
+
+    breaker = CircuitBreaker(failure_threshold=1, reset_s=0.0)
+    breaker.record_failure()  # open; reset_s=0 -> next allow half-opens
+    cb = make_cb(breaker=breaker)
+    empty = cb.submit(_win(rng, 0))
+    assert empty.result(0).shape == (0, COLS)  # well-formed empty reply
+    # the probe slot is still available for a REAL request...
+    real = cb.submit(_win(rng, 4))
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter() + 1.0)
+        spans = cb._take(k)
+    cb._dispatch(spans)  # ...whose success re-closes the breaker
+    assert real.result(0).shape == (4, COLS)
+    assert breaker.state == "closed"
+
+
+def test_backpressure_dynamic_retry_after(rng):
+    """Queue full -> Backpressure whose Retry-After reflects the LIVE
+    backlog over observed throughput once calibrated — not the fixed
+    1 s queue-drain guess (ISSUE satellite)."""
+    metrics = ServeMetrics()
+    cb = make_cb(max_queue=2, retry_after_s=1.0, metrics=metrics)
+    cb.submit(_win(rng, 16))
+    cb.submit(_win(rng, 16))
+    # uncalibrated: the static configured hint is all there is
+    with pytest.raises(Backpressure) as exc:
+        cb.submit(_win(rng, 1))
+    assert exc.value.retry_after_s == 1.0
+    assert metrics.counters["rejected"] == 1
+    # one dispatch calibrates windows/sec; the hint becomes backlog math
+    step(cb)
+    with cb._cv:
+        cb._ema_wps = 100.0  # pin the EMA: 100 windows/sec
+        backlog = sum(s.n - s.next for s in cb._pool)
+    with pytest.raises(Backpressure) as exc:
+        cb.submit(_win(rng, 1))
+    assert exc.value.retry_after_s == pytest.approx((backlog + 16) / 100.0)
+
+
+def test_queue_gauges_and_occupancy(rng):
+    metrics = ServeMetrics()
+    cb = make_cb(metrics=metrics)
+    cb.submit(_win(rng, 12))
+    assert metrics.queue_depth() == 1
+    assert metrics.queue_windows() == 12
+    assert metrics.occupancy() == pytest.approx(12 / 16)
+    text = metrics.render()
+    assert "roko_serve_queue_windows 12" in text
+    assert "roko_serve_scheduler_occupancy 0.7500" in text
+
+
+def test_metrics_padding_efficiency_and_size_classes(rng):
+    """padding_efficiency renders (the ISSUE's series) and completed
+    requests land in per-size-class latency rows."""
+    metrics = ServeMetrics()
+    metrics.size_classes = (8, 16)
+    cb = make_cb(metrics=metrics)
+    small, large = cb.submit(_win(rng, 2)), cb.submit(_win(rng, 14))
+    while not (small._req.done.is_set() and large._req.done.is_set()):
+        with cb._cv:
+            k, _ = cb._plan(time.perf_counter() + 1.0)
+            spans = cb._take(k) if k else None
+        if spans:
+            cb._dispatch(spans)
+    small.result(0), large.result(0)
+    text = metrics.render()
+    assert "roko_serve_padding_efficiency 1.0000" in text  # 16/16 dense
+    assert 'size_class="le8"' in text
+    assert 'size_class="le16"' in text
+    assert metrics.size_class(2) == "le8"
+    assert metrics.size_class(16) == "le16"
+    assert metrics.size_class(40) == "gt16"
+
+
+def test_config_validates_batching_policy():
+    with pytest.raises(ValueError, match="unknown batching policy"):
+        ServeConfig(batching="sometimes")
+    with pytest.raises(ValueError, match="rung_upgrade_fill"):
+        ServeConfig(rung_upgrade_fill=0.0)
+    with pytest.raises(ValueError, match="max_queue_age_ms"):
+        ServeConfig(max_queue_age_ms=-5.0)
+    assert ServeConfig().batching == "continuous"
+
+
+def test_cli_batching_flags_layer_into_config():
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args(
+        ["serve", "ckpt/", "--batching", "deadline",
+         "--max-queue-age-ms", "10", "--rung-upgrade-fill", "0.5"]
+    )
+    cfg = _build_config(args)
+    assert cfg.serve.batching == "deadline"
+    assert cfg.serve.max_queue_age_ms == 10.0
+    assert cfg.serve.rung_upgrade_fill == 0.5
+    defaults = _build_config(build_parser().parse_args(["serve", "ckpt/"]))
+    assert defaults.serve.batching == "continuous"
+    assert defaults.serve.max_queue_age_ms == 25.0
+
+
+# -- real-session gates ------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def session():
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    s = PolishSession(params, CFG)
+    s.warmup()
+    return s
+
+
+def test_zero_recompiles_across_mixed_sizes(session, rng):
+    """The ladder contract survives the new scheduler: mixed request
+    sizes through the ContinuousBatcher never add a jit cache entry."""
+    compiled = session.cache_size()
+    cb = ContinuousBatcher(session, max_queue_age_ms=5.0)
+    try:
+        futs = [cb.submit(_win(rng, n)) for n in (3, 16, 1, 9, 24)]
+        for n, f in zip((3, 16, 1, 9, 24), futs):
+            assert f.result(30.0).shape == (n, COLS)
+    finally:
+        cb.stop()
+    assert session.cache_size() == compiled
+    assert session.dispatched_shapes <= set(session.ladder)
+
+
+def test_continuous_results_match_solo_predict(session, rng):
+    """Dense packing on the real device path: every request's packed
+    result is byte-identical to a solo session.predict of its windows."""
+    cb = ContinuousBatcher(session, max_queue_age_ms=5.0)
+    try:
+        xs = [_win(rng, n) for n in (7, 2, 16, 5)]
+        futs = [cb.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_array_equal(f.result(30.0), session.predict(x))
+    finally:
+        cb.stop()
+
+
+def _serve_windows(rng, n):
+    x = rng.integers(0, C.FEATURE_VOCAB, (n, ROWS, COLS)).astype(np.uint8)
+    positions = np.zeros((n, COLS, 2), np.int64)
+    for i in range(n):
+        positions[i, :, 0] = np.arange(i * C.WINDOW_STRIDE,
+                                       i * C.WINDOW_STRIDE + COLS)
+    return positions, x
+
+
+def _spawn_server(session, serve_cfg):
+    srv = make_server(session, serve_cfg, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    return srv, thread
+
+
+def _stop_server(srv, thread):
+    srv.shutdown()
+    srv.batcher.stop()
+    srv.server_close()
+    thread.join(5.0)
+
+
+def test_http_byte_identity_continuous_vs_deadline_vs_cli(
+    session, rng, tmp_path
+):
+    """The ISSUE acceptance gate: for mixed request sizes, continuous-
+    mode replies are byte-identical to deadline-mode replies AND to the
+    batch ``roko-tpu inference`` path on the same windows/params."""
+    draft = "".join(rng.choice(list("ACGT"), 800))
+    cases = {}
+    for n in (2, 7, 16, 20):
+        positions, x = _serve_windows(rng, n)
+        path = tmp_path / f"infer{n}.hdf5"
+        with DataWriter(str(path), infer=True) as w:
+            w.write_contigs([("ctg", draft)])
+            w.store("ctg", list(positions), list(x), None)
+        expected = run_inference(
+            str(path), session.params, CFG, batch_size=8, log=lambda s: None
+        )["ctg"]
+        cases[n] = (positions, x, expected)
+
+    for mode in ("continuous", "deadline"):
+        srv, thread = _spawn_server(
+            session, dataclasses.replace(CFG.serve, batching=mode)
+        )
+        try:
+            client = PolishClient(
+                f"http://127.0.0.1:{srv.server_address[1]}"
+            )
+            health = client.healthz()
+            assert health["batching"] == mode
+            for n, (positions, x, expected) in cases.items():
+                reply = client.polish(draft, positions, x, contig="ctg")
+                assert reply["polished"] == expected, (mode, n)
+                assert reply["windows"] == n
+            text = client.metrics()
+            assert "roko_serve_padding_efficiency" in text
+            assert 'size_class="le8"' in text
+        finally:
+            _stop_server(srv, thread)
+
+
+def test_concurrent_http_mixed_traffic(session, rng):
+    """Many clients, mixed sizes, one continuous server: every reply
+    correct, no errors, no stuck futures."""
+    srv, thread = _spawn_server(
+        session, dataclasses.replace(CFG.serve, max_queue=32)
+    )
+    try:
+        draft = "".join(rng.choice(list("ACGT"), 800))
+        small = _serve_windows(rng, 2)
+        large = _serve_windows(rng, 20)
+        errors = []
+
+        def one_client(i):
+            client = PolishClient(
+                f"http://127.0.0.1:{srv.server_address[1]}", timeout=60.0
+            )
+            for j in range(4):
+                positions, x = large if (i + j) % 4 == 0 else small
+                try:
+                    r = client.polish(draft, positions, x, retries=6)
+                    assert r["windows"] == len(x)
+                except Exception as e:  # pragma: no cover - failure detail
+                    errors.append(repr(e))
+
+        threads = [
+            threading.Thread(target=one_client, args=(i,), daemon=True)
+            for i in range(4)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120.0)
+        assert errors == []
+    finally:
+        _stop_server(srv, thread)
+
+
+# -- mixed-traffic fleet e2e (slow) ------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_mixed_traffic_zero_client_errors(tmp_path, rng):
+    """ISSUE satellite: mixed small/large traffic against a REAL
+    2-worker fleet running the continuous scheduler — zero client
+    errors, every reply byte-identical to the batch inference path,
+    and the per-worker padding series visible at the front end."""
+    from roko_tpu.compile import export_bundle
+    from roko_tpu.serve.fleet import Fleet
+    from roko_tpu.serve.supervisor import make_front_server, worker_command
+    from roko_tpu.training.checkpoint import save_params
+
+    cfg = RokoConfig(
+        model=TINY,
+        mesh=MeshConfig(dp=8),
+        serve=ServeConfig(
+            ladder=(8, 16), batching="continuous", max_queue_age_ms=20.0
+        ),
+        fleet=dataclasses.replace(
+            RokoConfig().fleet,
+            workers=2,
+            heartbeat_interval_s=0.25,
+            heartbeat_timeout_s=2.0,
+            spawn_deadline_s=60.0,
+            stable_after_s=1.0,
+            restart_base_delay_s=0.1,
+        ),
+    )
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+    ckpt = str(tmp_path / "ckpt")
+    save_params(ckpt, params)
+    bundle = str(tmp_path / "bundle")
+    export_bundle(bundle, cfg, ladder=(8, 16), log=lambda m: None)
+    cfg = dataclasses.replace(
+        cfg, compile=dataclasses.replace(cfg.compile, bundle_dir=bundle)
+    )
+    cfg_path = str(tmp_path / "worker-config.json")
+    with open(cfg_path, "w") as f:
+        f.write(
+            dataclasses.replace(
+                cfg, fleet=dataclasses.replace(cfg.fleet, workers=0)
+            ).to_json()
+        )
+
+    draft = "".join(rng.choice(list("ACGT"), 800))
+    cases = {}
+    for n in (3, 24):  # small, and large enough to chunk at the top rung
+        positions, x = _serve_windows(rng, n)
+        path = tmp_path / f"infer{n}.hdf5"
+        with DataWriter(str(path), infer=True) as w:
+            w.write_contigs([("ctg", draft)])
+            w.store("ctg", list(positions), list(x), None)
+        expected = run_inference(
+            str(path), params, cfg, batch_size=8, log=lambda s: None
+        )["ctg"]
+        cases[n] = (positions, x, expected)
+
+    fleet = Fleet(
+        cfg,
+        worker_command(ckpt, cfg_path),
+        runtime_dir=str(tmp_path / "fleet"),
+        log=lambda m: None,
+    )
+    fleet.start()
+    server = thread = None
+    try:
+        deadline = time.monotonic() + 180.0
+        while fleet.ready_count() < 2:
+            assert time.monotonic() < deadline, "2 real workers warm"
+            time.sleep(0.2)
+        server = make_front_server(fleet, port=0)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        port = server.server_address[1]
+        errors, bad = [], []
+
+        def one_client(i):
+            client = PolishClient(f"http://127.0.0.1:{port}", timeout=120.0)
+            for j in range(8):
+                n = 24 if (i + j) % 5 == 0 else 3  # ~80/20 mixed traffic
+                positions, x, expected = cases[n]
+                try:
+                    r = client.polish(
+                        draft, positions, x, contig="ctg", retries=8
+                    )
+                except Exception as e:
+                    errors.append(repr(e))
+                    continue
+                if r["polished"] != expected:
+                    bad.append(n)
+
+        clients = [
+            threading.Thread(target=one_client, args=(i,), daemon=True)
+            for i in range(3)
+        ]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join(300.0)
+        assert errors == []  # zero client-visible failures
+        assert bad == []  # byte-identical, every reply
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ) as r:
+            text = r.read().decode()
+        assert 'roko_serve_padding_efficiency{worker="' in text
+        assert 'roko_serve_scheduler_occupancy{worker="' in text
+    finally:
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+            thread.join(10.0)
+        fleet.stop(rolling=False)
