@@ -62,6 +62,39 @@ def _device_peak_flops() -> Optional[float]:
     return None
 
 
+def model_param_bytes(cfg) -> int:
+    """Serving-representation bytes of the whole param tree for the
+    recurrent consensus models — the companion to
+    :func:`model_flops_per_window` that makes the memory-bound claim
+    checkable from BENCH_*.json alone (flops / bytes = arithmetic
+    intensity). Accounting is STORAGE bytes, i.e. what a predict
+    dispatch streams from HBM: float params are stored f32 even under
+    ``compute_dtype="bfloat16"`` (the cast happens in-program), so bf16
+    changes compute width but NOT these bytes; ``quantize="int8"``
+    stores each targeted matmul kernel as 1 B/element plus a 4 B f32
+    scale per output channel (models/quant.py) — the actual 4x byte
+    cut. Counted off the model's OWN init tree via ``jax.eval_shape``
+    (no compute, no params), so it can never drift from what
+    ``model.init``/``quantize_params`` actually build — any kind, any
+    future layout."""
+    import jax
+
+    from roko_tpu.models.model import RokoModel
+
+    shapes = jax.eval_shape(RokoModel(cfg).init, jax.random.PRNGKey(0))
+    return sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(shapes)
+    )
+
+
+def model_param_bytes_per_window(cfg, batch: int) -> float:
+    """Weight bytes charged to ONE window when a dispatch of ``batch``
+    windows streams the params once: ``model_param_bytes / batch``.
+    ``model_flops_per_window / this`` is the arithmetic intensity the
+    bench precision rows report."""
+    return model_param_bytes(cfg) / max(1, batch)
+
+
 def model_flops_per_window(cfg, *, training: bool = False) -> float:
     """Analytic matmul FLOPs per window for the recurrent consensus
     models (``kind="gru"`` and ``kind="lingru"``). Inference uses the
@@ -182,37 +215,55 @@ def bench_recurrence(kind: str, batch: int, iters: int) -> float:
 def bench_precision(
     kind: str, batch: int, iters: int, model_overrides: Optional[Dict] = None
 ) -> Dict[str, Any]:
-    """The compute-dtype precision column (seeds ROADMAP item 4): f32 vs
-    bf16 windows/sec on identical work, plus the max-abs logit delta
-    between the two dtypes on one shared (params, batch) — the cheap
-    accuracy-drift bound a held-out Q check would refine. bf16 rides the
-    MXU on TPU but is EMULATED on CPU, so a CPU artifact can honestly
-    show bf16 *slower*; ``env.backend`` disambiguates."""
+    """The precision column (ROADMAP item 1): f32 vs bf16 vs int8
+    weight-only windows/sec on identical fixed work, plus the max-abs
+    logit delta of each reduced-precision variant against the SAME f32
+    (params, batch) — the cheap accuracy-drift bound the held-out Q
+    gate (tests/test_precision.py slow lane) refines. Each variant also
+    reports its param-bytes-per-window and arithmetic intensity
+    (``model_param_bytes`` — int8 is the one that actually cuts the
+    bytes; bf16 narrows compute, not storage). bf16 rides the MXU on
+    TPU but is EMULATED on CPU, so a CPU artifact can honestly show
+    bf16 *slower*, and the int8 dequant-in-matmul similarly only beats
+    f32 where weight HBM traffic (not host FLOPs) bounds the step;
+    ``env.backend`` disambiguates."""
     import jax
     import jax.numpy as jnp
 
     from roko_tpu import constants as C
     from roko_tpu.config import ModelConfig
     from roko_tpu.models.model import RokoModel
+    from roko_tpu.models.quant import quantize_params
 
     over = model_overrides or {}
     cfg32 = ModelConfig(kind=kind, compute_dtype="float32", **over)
     cfgbf = ModelConfig(kind=kind, compute_dtype="bfloat16", **over)
+    cfg8 = ModelConfig(
+        kind=kind, compute_dtype="float32", quantize="int8", **over
+    )
     row: Dict[str, Any] = {"model_kind": kind, "batch": batch}
     row["f32_windows_per_sec"] = round(bench_infer(cfg32, batch, iters), 1)
     row["bf16_windows_per_sec"] = round(bench_infer(cfgbf, batch, iters), 1)
-    m32, mbf = RokoModel(cfg32), RokoModel(cfgbf)
+    row["int8_windows_per_sec"] = round(bench_infer(cfg8, batch, iters), 1)
+    flops = model_flops_per_window(cfg32)
+    for tag, c in (("f32", cfg32), ("bf16", cfgbf), ("int8", cfg8)):
+        pb = model_param_bytes_per_window(c, batch)
+        row[f"{tag}_param_bytes_per_window"] = round(pb, 1)
+        row[f"{tag}_flops_per_param_byte"] = round(flops / pb, 1)
+    m32, mbf, m8 = RokoModel(cfg32), RokoModel(cfgbf), RokoModel(cfg8)
     params = m32.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
     x = rng.integers(
         0, C.FEATURE_VOCAB,
         (min(batch, 16), cfg32.window_rows, cfg32.window_cols),
     ).astype(np.uint8)
-    delta = jnp.abs(
-        m32.apply(params, x, deterministic=True)
-        - mbf.apply(params, x, deterministic=True)
-    )
+    ref = m32.apply(params, x, deterministic=True)
+    delta = jnp.abs(ref - mbf.apply(params, x, deterministic=True))
     row["max_abs_logit_delta"] = round(float(delta.max()), 5)
+    delta8 = jnp.abs(
+        ref - m8.apply(quantize_params(params, cfg8), x, deterministic=True)
+    )
+    row["int8_max_abs_logit_delta"] = round(float(delta8.max()), 5)
     return row
 
 
@@ -325,7 +376,7 @@ def run_inference_suite(
     rows on disk (r5: the chip can stop answering MID-compile)."""
     import jax
 
-    from roko_tpu.config import ModelConfig
+    from roko_tpu.config import ModelConfig, default_compute_dtype
 
     on_tpu = jax.default_backend() == "tpu"
     # batch=None (the default run) sweeps SWEEP_BATCHES on TPU, with the
@@ -339,8 +390,15 @@ def run_inference_suite(
     # item 6 — wall-clock-shaped sampling made r04->r05 uninterpretable)
     iters = ITERS if iters is None else iters
     detail: Dict[str, Any] = {"batch": batches[0], "iterations": iters}
-    cfg = ModelConfig(compute_dtype="bfloat16")
-    cfg_p = ModelConfig(compute_dtype="bfloat16", use_pallas=True)
+    # the SERVING default dtype per backend (bf16 on TPU, f32 on CPU —
+    # one policy, config.default_compute_dtype), so the headline
+    # measures what `roko-tpu serve` actually runs. Recorded in the
+    # artifact: a cross-round compare whose headline dtype changed is a
+    # DEFINITION change, not a perf delta, and must say so
+    dtype = default_compute_dtype()
+    detail["compute_dtype"] = dtype
+    cfg = ModelConfig(compute_dtype=dtype)
+    cfg_p = ModelConfig(compute_dtype=dtype, use_pallas=True)
     best, best_batch, sweep = 0.0, None, {}
     detail["batch_sweep"] = sweep
     from roko_tpu.compile.cache import active_cache_dir, cache_counters
@@ -399,7 +457,7 @@ def run_inference_suite(
         d_l: Dict[str, Any] = {}
         lin_row["scan_windows_per_sec"] = round(
             bench_infer(
-                ModelConfig(kind="lingru", compute_dtype="bfloat16"),
+                ModelConfig(kind="lingru", compute_dtype=dtype),
                 b0, iters, detail=d_l,
             ),
             1,
@@ -461,6 +519,13 @@ def run_inference_suite(
     detail["best_batch"] = best_batch
     flops = model_flops_per_window(cfg)
     detail["model_flops_per_window"] = round(flops)
+    # arithmetic-intensity companion (ISSUE 11 satellite): total weight
+    # bytes a dispatch streams, per-window share at the headline batch,
+    # and flops/byte — the memory-bound claim, checkable from the JSON
+    detail["model_param_bytes"] = model_param_bytes(cfg)
+    pbpw = model_param_bytes_per_window(cfg, best_batch or batches[0])
+    detail["param_bytes_per_window"] = round(pbpw, 1)
+    detail["flops_per_param_byte"] = round(flops / pbpw, 1)
     peak = _device_peak_flops()
     if peak:
         detail["mfu_pct"] = round(100.0 * best * flops / peak, 2)
@@ -479,12 +544,13 @@ def run_train_suite(
     ``progress`` (if given) is called with the in-progress suite dict
     after every row so an abandoned child leaves completed rows on
     disk."""
-    from roko_tpu.config import ModelConfig
+    from roko_tpu.config import ModelConfig, default_compute_dtype
 
     import jax
 
     t0 = time.perf_counter()
     peak = _device_peak_flops()
+    dtype = default_compute_dtype()
     iters = ITERS if iters is None else iters
     out: Dict[str, Any] = {"batch": batch, "iterations": iters}
     # Order = information value under a tight budget (each suite costs
@@ -496,33 +562,36 @@ def run_train_suite(
     # BASELINE.md rows; the fused-Pallas row last because r3 measured
     # v2 within noise of the scan path (the v3 kernels may change
     # that).
+    # every row trains at the backend's serving-default dtype (ONE
+    # policy: config.default_compute_dtype — bf16 on TPU, f32 on CPU
+    # where bf16 is emulated)
     suites = {
-        "train_gru": ModelConfig(compute_dtype="bfloat16"),
+        "train_gru": ModelConfig(compute_dtype=dtype),
         "train_gru_remat": ModelConfig(
-            compute_dtype="bfloat16", remat_frontend=True
+            compute_dtype=dtype, remat_frontend=True
         ),
         # anomaly lever 2: recompute the scan cell's gates in the
         # backward instead of streaming 90 steps of stored activations
         # (ModelConfig.remat_scan)
         "train_gru_remat_scan": ModelConfig(
-            compute_dtype="bfloat16", remat_scan=True
+            compute_dtype=dtype, remat_scan=True
         ),
         # anomaly lever 3: same model, rbg dropout-mask PRNG
         # (TrainConfig.dropout_rng_impl) — three threefry masks per
         # step sit inside the fwd+bwd pipeline
-        "train_gru_rbg": ModelConfig(compute_dtype="bfloat16"),
+        "train_gru_rbg": ModelConfig(compute_dtype=dtype),
         "train_scan_stress": ModelConfig(
-            compute_dtype="bfloat16", num_layers=4, hidden_size=256
+            compute_dtype=dtype, num_layers=4, hidden_size=256
         ),
         "train_transformer": ModelConfig(
-            compute_dtype="bfloat16", kind="transformer", d_model=256
+            compute_dtype=dtype, kind="transformer", d_model=256
         ),
     }
     if jax.default_backend() == "tpu":
         # off-TPU use_pallas silently falls back to the scan path, so a
         # 'pallas' row would just re-time the scan under a false name.
         suites["train_gru_pallas"] = ModelConfig(
-            compute_dtype="bfloat16", use_pallas=True
+            compute_dtype=dtype, use_pallas=True
         )
     else:
         out["train_gru_pallas"] = {"error": "pallas kernels need a TPU backend"}
@@ -560,7 +629,7 @@ def run_train_suite(
     else:
         try:
             stall = bench_input_stall(
-                ModelConfig(compute_dtype="bfloat16"), batch, iters
+                ModelConfig(compute_dtype=dtype), batch, iters
             )
             out["input_stall"] = stall
             out["input_stall_fraction"] = stall["stall_fraction"]
@@ -1156,6 +1225,18 @@ def compare_to_previous(
             (row or {}).get("scan_windows_per_sec"),
             prow.get("scan_windows_per_sec"),
         )
+    # precision rows (ISSUE 11): the f32/bf16/int8 columns compare
+    # cross-round on the same fixed work, same noise discipline
+    for kind, row in (cur_d.get("precision") or {}).items():
+        prow = (prev_d.get("precision") or {}).get(kind) or {}
+        for col in (
+            "f32_windows_per_sec",
+            "bf16_windows_per_sec",
+            "int8_windows_per_sec",
+        ):
+            pairs[f"precision.{kind}.{col}"] = (
+                (row or {}).get(col), prow.get(col),
+            )
     metrics: Dict[str, Any] = {}
     for name, (cur, old) in pairs.items():
         if (
@@ -1174,14 +1255,33 @@ def compare_to_previous(
         if delta_pct <= -noise_band_pct:
             row["regression"] = True
         metrics[name] = row
-    # comparisons are only interpretable on identical fixed work: record
-    # both sides' pinned iteration counts so a mismatch is visible
+    # comparisons are only interpretable on identical fixed work AND an
+    # identical measurement regime: record both sides' pinned iteration
+    # counts and headline compute dtypes so a mismatch is visible
     block = {
         "noise_band_pct": noise_band_pct,
         "iterations": cur_d.get("iterations"),
         "previous_iterations": prev_d.get("iterations"),
+        "compute_dtype": cur_d.get("compute_dtype"),
+        "previous_compute_dtype": prev_d.get("compute_dtype"),
         "metrics": metrics,
     }
+    cur_dtype, prev_dtype = block["compute_dtype"], block["previous_compute_dtype"]
+    if cur_dtype is not None and prev_dtype != cur_dtype:
+        # headline dtype moved (or the previous artifact predates the
+        # record — pre-PR-11 CPU headlines hardcoded bf16): the deltas
+        # above compare different PROGRAMS, not code speed
+        block["regime_change"] = (
+            f"headline compute dtype is {cur_dtype!r} but the previous "
+            f"artifact's was {prev_dtype!r}"
+            + (
+                " (absent = pre-precision-plane artifact; its CPU "
+                "headline measured emulated bfloat16)"
+                if prev_dtype is None
+                else ""
+            )
+            + " — deltas reflect the dtype change, not a code regression"
+        )
     result.setdefault("detail", {})["vs_previous"] = block
     return block
 
@@ -1378,7 +1478,11 @@ def run_e2e_suite(draft_len: int = 2_000_000, coverage: int = 20) -> Dict[str, A
 
     import jax
 
-    from roko_tpu.config import ModelConfig, RokoConfig
+    from roko_tpu.config import (
+        ModelConfig,
+        RokoConfig,
+        default_compute_dtype,
+    )
     from roko_tpu.features.pipeline import run_features
     from roko_tpu.infer import run_inference
     from roko_tpu.io.bam import write_sorted_bam
@@ -1418,7 +1522,9 @@ def run_e2e_suite(draft_len: int = 2_000_000, coverage: int = 20) -> Dict[str, A
         out["windows"] = n
         out["features_windows_per_sec"] = round(n / features_s, 1)
 
-        cfg = RokoConfig(model=ModelConfig(compute_dtype="bfloat16"))
+        cfg = RokoConfig(
+            model=ModelConfig(compute_dtype=default_compute_dtype())
+        )
         model = RokoModel(cfg.model)
         params = model.init(jax.random.PRNGKey(0))
         lines: list = []
@@ -1467,7 +1573,11 @@ def run_pipeline_suite(
 
     import jax
 
-    from roko_tpu.config import ModelConfig, RokoConfig
+    from roko_tpu.config import (
+        ModelConfig,
+        RokoConfig,
+        default_compute_dtype,
+    )
     from roko_tpu.features.pipeline import run_features
     from roko_tpu.infer import run_inference
     from roko_tpu.io.bam import write_sorted_bam
@@ -1497,9 +1607,11 @@ def run_pipeline_suite(
 
         # the backend's fast dtype: bf16 rides the MXU on TPU but is
         # EMULATED on CPU (~3x slower than f32) — the suite measures
-        # stage overlap, not dtype emulation
-        dtype = "bfloat16" if jax.default_backend() == "tpu" else "float32"
-        cfg = RokoConfig(model=ModelConfig(compute_dtype=dtype))
+        # stage overlap, not dtype emulation. ONE policy for the whole
+        # bench: config.default_compute_dtype
+        cfg = RokoConfig(
+            model=ModelConfig(compute_dtype=default_compute_dtype())
+        )
         params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
         quiet = lambda *a, **k: None  # noqa: E731
 
@@ -1864,7 +1976,7 @@ def run_serve_suite(
     expected = {size: session.predict(x) for size, x in payloads.items()}
     schedule = _mix_schedule(mix, iterations)
 
-    def drive(mode: str) -> Dict[str, Any]:
+    def drive(mode: str, session=session, expected=expected) -> Dict[str, Any]:
         metrics = ServeMetrics()
         metrics.size_classes = ladder
         if mode == "continuous":
@@ -1956,6 +2068,63 @@ def run_serve_suite(
             results["small_p99_improvement"] = round(d / c, 3)
     except KeyError:
         pass
+
+    # -- precision A/B row (ISSUE 11): the SAME seeded mixed schedule,
+    # continuous mode, against sessions differing only in precision —
+    # the serving-path counterpart of the device-only precision column.
+    # The baseline row is the continuous-mode measurement above (the
+    # backend's resolved default dtype); int8 weight-only always runs,
+    # f32/bf16 alternates join when the default differs from them. Each
+    # variant's byte-identity check is against its OWN solo predicts
+    # (reduced precision legitimately differs from f32 at the logit
+    # level — the held-out-Q slow lane gates that drift).
+    resolved = session.model.cfg
+    base_tag = resolved.compute_dtype + (
+        f"+{resolved.quantize}" if resolved.quantize else ""
+    )
+    # variant SPECS only here — ModelConfig construction re-validates in
+    # __post_init__ and must happen inside the per-variant try, so an
+    # invalid combination reports as that variant's error instead of
+    # voiding the completed mode measurements above
+    variants: Dict[str, Tuple[str, Optional[str]]] = {}
+    if resolved.quantize != "int8" and resolved.kind != "transformer":
+        # the transformer kind has no int8 path (ModelConfig refuses)
+        variants["float32+int8"] = ("float32", "int8")
+    if resolved.compute_dtype != "float32" or resolved.quantize:
+        variants["float32"] = ("float32", None)
+    prec: Dict[str, Any] = {
+        "baseline": base_tag,
+        "modes": {base_tag: results["modes"]["continuous"]},
+    }
+    results["precision"] = prec
+    for tag, (vdtype, vquant) in variants.items():
+        try:
+            vcfg = dataclasses.replace(
+                cfg,
+                model=dataclasses.replace(
+                    cfg.model, compute_dtype=vdtype, quantize=vquant
+                ),
+            )
+            # raw f32 params: the session applies the int8 conversion
+            # itself, exactly as `serve --quantize int8` would
+            vsession = PolishSession(params, vcfg)
+            vsession.warmup()
+            vexpected = {
+                size: vsession.predict(x) for size, x in payloads.items()
+            }
+            prec["modes"][tag] = drive(
+                "continuous", session=vsession, expected=vexpected
+            )
+        except Exception as e:  # report, never swallow
+            prec["modes"][tag] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    base_rps = prec["modes"][base_tag].get("req_per_s")
+    int8_tag = base_tag if resolved.quantize == "int8" else "float32+int8"
+    int8_rps = (prec["modes"].get(int8_tag) or {}).get("req_per_s")
+    f32_rps = (
+        prec["modes"].get("float32") or {}
+    ).get("req_per_s") or (base_rps if base_tag == "float32" else None)
+    if int8_rps and f32_rps:
+        prec["int8_req_per_s_vs_f32"] = round(int8_rps / f32_rps, 3)
     return results
 
 

@@ -41,7 +41,22 @@ def _model_args(p: argparse.ArgumentParser) -> None:
     )
     p.add_argument("--hidden-size", type=int, default=None)
     p.add_argument("--num-layers", type=int, default=None)
-    p.add_argument("--compute-dtype", default=None, choices=("float32", "bfloat16"))
+    p.add_argument(
+        "--compute-dtype", default=None,
+        choices=("auto", "float32", "bfloat16"),
+        help="matmul compute dtype (params stay f32). Default auto: "
+        "bfloat16 on TPU backends, float32 elsewhere; AOT bundle "
+        "digests carry the resolved dtype (README 'Precision')",
+    )
+    p.add_argument(
+        "--quantize", default=None, choices=("int8", "none"),
+        help="weight-only quantization of the dense/GRU/lingru matmul "
+        "kernels, applied when the checkpoint is LOADED (training "
+        "always runs full precision): int8 with per-output-channel f32 "
+        "scales; 'none' overrides a --config file's setting. On "
+        "`compile` this emits a quantized AOT bundle with its own "
+        "digest (README 'Precision')",
+    )
     p.add_argument("--use-pallas", action="store_true", default=None,
                    help="fused Pallas GRU kernels on TPU (inference + training)")
     p.add_argument("--d-model", type=int, default=None,
@@ -248,6 +263,13 @@ def _build_config(args: argparse.Namespace):
         compute_dtype="compute_dtype", use_pallas="use_pallas",
         d_model="d_model", num_heads="num_heads", mlp_ratio="mlp_ratio",
     )
+    # --quantize none must be able to CLEAR a --config file's setting,
+    # so the None-skipping over() helper can't carry it
+    quantize = getattr(args, "quantize", None)
+    if quantize is not None:
+        model = dataclasses.replace(
+            model, quantize=None if quantize == "none" else quantize
+        )
     # the transformer head is shared with the GRU family, so d_model
     # tracks 2*hidden unless explicitly set
     if getattr(args, "hidden_size", None) is not None and getattr(args, "d_model", None) is None:
@@ -570,9 +592,16 @@ def cmd_compile(args: argparse.Namespace) -> int:
     if args.b:
         rungs.add(args.b)  # batch-CLI runs dispatch at --b too
     manifest = export_bundle(args.out, cfg, ladder=sorted(rungs))
+    # precision identity straight from the DIGESTED manifest (not the
+    # pre-resolution config), so the operator-visible line names exactly
+    # what a mismatched load would refuse on
+    ident_model = manifest["identity"]["model"]
     print(
         f"compile: wrote bundle {args.out} "
-        f"(kind {cfg.model.kind}, rungs {manifest['rungs']}, "
+        f"(kind {cfg.model.kind}, "
+        f"compute_dtype={ident_model['compute_dtype']}, "
+        f"quantize={ident_model['quantize'] or 'none'}, "
+        f"rungs {manifest['rungs']}, "
         f"digest {manifest['digest'][:12]})"
     )
     if not args.no_verify:

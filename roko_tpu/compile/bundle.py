@@ -63,14 +63,21 @@ def bundle_identity(cfg, mesh=None, *, backend: Optional[str] = None) -> Dict[st
 
     mesh = mesh or make_mesh(cfg.mesh)
     dev = np.asarray(mesh.devices).flat[0]
+    platform = backend or dev.platform
     return _canonical(
         {
             "bundle_version": BUNDLE_VERSION,
             "jax_version": jax.__version__,
-            "backend": backend or dev.platform,
+            "backend": platform,
             "device_kind": dev.device_kind,
             "mesh": dict(mesh.shape),
-            "model": dataclasses.asdict(cfg.model),
+            # compute_dtype="auto" digests as the CONCRETE dtype it
+            # resolves to on this backend (bf16 on TPU, f32 elsewhere):
+            # an "auto" session and an explicit one compile the same
+            # program and must share a digest, while a bf16 bundle
+            # loaded into an f32 session refuses naming
+            # model.compute_dtype (quantize rides in the same dict)
+            "model": dataclasses.asdict(cfg.model.resolve(platform)),
         }
     )
 

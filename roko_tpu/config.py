@@ -58,6 +58,35 @@ class RegionConfig:
 #: inference, models/lingru.py), "transformer" the attention variant
 MODEL_KINDS = ("gru", "lingru", "transformer")
 
+#: valid ``ModelConfig.compute_dtype`` values. "auto" resolves per
+#: backend at model construction (``default_compute_dtype``): bfloat16
+#: on TPU — the matmuls ride the MXU at half the HBM operand width —
+#: and float32 everywhere else (bf16 is EMULATED on CPU, slower than
+#: f32). Params are always STORED float32; the dtype is the matmul
+#: compute width.
+COMPUTE_DTYPES = ("auto", "float32", "bfloat16")
+
+#: valid ``ModelConfig.quantize`` values (besides None = off): "int8"
+#: is conversion-time weight-only quantization of the dense/GRU/lingru
+#: matmul kernels to int8 with per-output-channel float32 scales
+#: (models/quant.py). Activations, biases, the embedding, logits, and
+#: recurrence state stay float — int8 cuts the bytes each weight moves
+#: from HBM per window by 4x, the memory-bound serving lever.
+QUANTIZE_MODES = ("int8",)
+
+
+def default_compute_dtype(backend: Optional[str] = None) -> str:
+    """The concrete compute dtype ``compute_dtype="auto"`` resolves to
+    on ``backend`` (default: the live jax backend): bfloat16 on TPU,
+    float32 everywhere else. The ONE place the TPU-defaults policy
+    lives — the CLI, every bench suite, and model construction all
+    resolve through here."""
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    return "bfloat16" if backend == "tpu" else "float32"
+
 
 @dataclass(frozen=True)
 class ModelConfig:
@@ -80,8 +109,18 @@ class ModelConfig:
     d_model: int = 256
     num_heads: int = 8
     mlp_ratio: int = 4
-    # compute dtype for matmuls ("bfloat16" rides the MXU; params stay f32)
-    compute_dtype: str = "float32"
+    # compute dtype for matmuls, one of COMPUTE_DTYPES ("bfloat16" rides
+    # the MXU; params stay f32). "auto" (the default) resolves per
+    # backend at model construction — bf16 on TPU, f32 elsewhere
+    # (default_compute_dtype); AOT bundle digests carry the RESOLVED
+    # dtype, so a bf16 bundle refuses to load into an f32 session
+    compute_dtype: str = "auto"
+    # weight-only quantization mode, one of QUANTIZE_MODES or None.
+    # CONVERSION-TIME only: training always runs full precision; the
+    # params are quantized when loaded for inference/serve (or when
+    # `roko-tpu compile --quantize int8` builds an AOT bundle, whose
+    # digest then covers this field — models/quant.py)
+    quantize: Optional[str] = None
     # use the Pallas fused GRU kernel when running on TPU
     use_pallas: bool = False
     # rematerialise the embed->fc2 front-end in the training backward
@@ -108,6 +147,34 @@ class ModelConfig:
                 f"unknown model kind {self.kind!r}; expected one of "
                 + "|".join(MODEL_KINDS)
             )
+        if self.compute_dtype not in COMPUTE_DTYPES:
+            raise ValueError(
+                f"unknown compute_dtype {self.compute_dtype!r}; expected "
+                "one of " + "|".join(COMPUTE_DTYPES)
+            )
+        if self.quantize is not None and self.quantize not in QUANTIZE_MODES:
+            raise ValueError(
+                f"unknown quantize mode {self.quantize!r}; expected one "
+                "of " + "|".join(QUANTIZE_MODES) + " (or null/absent)"
+            )
+        if self.quantize is not None and self.kind == "transformer":
+            raise ValueError(
+                "quantize covers the gru/lingru consensus models (their "
+                "dense/recurrence matmul kernels); the transformer "
+                "variant has no int8 weight path"
+            )
+
+    def resolve(self, backend: Optional[str] = None) -> "ModelConfig":
+        """This config with ``compute_dtype="auto"`` replaced by the
+        backend's concrete default (no-op when already concrete). The
+        AOT bundle identity and the model itself both resolve through
+        here, so an "auto" session and an explicit-f32 session on the
+        same backend share one digest."""
+        if self.compute_dtype != "auto":
+            return self
+        return dataclasses.replace(
+            self, compute_dtype=default_compute_dtype(backend)
+        )
 
     @property
     def gru_in_size(self) -> int:

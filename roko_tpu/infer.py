@@ -418,6 +418,12 @@ def run_inference(
 
     enable_persistent_cache(cfg.compile)
     model = RokoModel(cfg.model)
+    # conversion-time weight-only quantization (models/quant.py): a raw
+    # f32 checkpoint loads through the int8 converter when the config
+    # asks for it; already-quantized params pass through untouched
+    from roko_tpu.models.quant import maybe_quantize
+
+    params = maybe_quantize(params, model.cfg)
     params = jax.device_put(params, replicated_sharding(mesh))
     predict = make_predict_step(model, mesh)
     sharding = data_sharding(mesh)

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from roko_tpu.models.layers import dropout as _dropout
+from roko_tpu.models.layers import dropout as _dropout, weight as _weight
 
 
 def _pallas_backend() -> bool:
@@ -79,10 +79,11 @@ def gru_direction(
     params: Dict[str, jax.Array], x: jax.Array, reverse: bool
 ) -> jax.Array:
     """Run one direction over ``x`` [B,T,in] -> [B,T,H]."""
-    hidden = params["w_hh"].shape[0]
-    x_proj = x @ params["w_ih"] + params["b_ih"]
+    w_hh = _weight(params["w_hh"], x.dtype)
+    hidden = w_hh.shape[0]
+    x_proj = x @ _weight(params["w_ih"], x.dtype) + params["b_ih"]
     h0 = jnp.zeros((x.shape[0], hidden), x_proj.dtype)
-    return _gru_scan(x_proj, h0, params["w_hh"], params["b_hh"], reverse)
+    return _gru_scan(x_proj, h0, w_hh, params["b_hh"], reverse)
 
 
 def bidir_layer(
@@ -97,17 +98,28 @@ def bidir_layer(
     scan count per forward (3 instead of 6) and doubles the per-step
     MXU work — the serial chain is latency-bound, so fewer/fatter steps
     win. Numerically identical to two ``gru_direction`` calls."""
-    hidden = layer["fwd"]["w_hh"].shape[0]
+    # weight() dequantizes int8 weight-only kernels in place
+    # (models/quant.py); plain f32/bf16 kernels pass through untouched
+    w_hh_f = _weight(layer["fwd"]["w_hh"], x.dtype)
+    hidden = w_hh_f.shape[0]
     B = x.shape[0]
     # one [B*T, in] x [in, 6H] MXU matmul projects both directions
-    w_ih2 = jnp.concatenate([layer["fwd"]["w_ih"], layer["bwd"]["w_ih"]], axis=1)
+    w_ih2 = jnp.concatenate(
+        [
+            _weight(layer["fwd"]["w_ih"], x.dtype),
+            _weight(layer["bwd"]["w_ih"], x.dtype),
+        ],
+        axis=1,
+    )
     b_ih2 = jnp.concatenate([layer["fwd"]["b_ih"], layer["bwd"]["b_ih"]])
     xp = x @ w_ih2 + b_ih2  # [B,T,6H]
     xp_f = xp[..., : 3 * hidden]
     xp_b = jnp.flip(xp[..., 3 * hidden :], axis=1)
     # [T, 2, B, 3H]: scan axis leads, direction is a batched-matmul dim
     xs = jnp.stack([xp_f, xp_b], axis=0).transpose(2, 0, 1, 3)
-    w_hh2 = jnp.stack([layer["fwd"]["w_hh"], layer["bwd"]["w_hh"]])  # [2,H,3H]
+    w_hh2 = jnp.stack(
+        [w_hh_f, _weight(layer["bwd"]["w_hh"], x.dtype)]
+    )  # [2,H,3H]
     b_hh2 = jnp.stack([layer["fwd"]["b_hh"], layer["bwd"]["b_hh"]])[:, None]
 
     def cell(h, xp_t):  # h [2,B,H], xp_t [2,B,3H]
@@ -200,6 +212,11 @@ class RokoGRU:
         if self.use_pallas and _pallas_backend():
             from roko_tpu.models.pallas_gru import bidir_gru_stack_pallas
 
+            from roko_tpu.models.quant import dequantize_params
+
+            # the fused kernels take dense weights; int8 weight-only
+            # params dequantize here (still inside the jitted program)
+            params = dequantize_params(params, x.dtype)
             return bidir_gru_stack_pallas(
                 params,
                 x,

@@ -24,8 +24,34 @@ def dense_params(rng, in_dim: int, out_dim: int, dtype=jnp.float32) -> Dict:
     }
 
 
+def is_quantized_weight(w) -> bool:
+    """True for the int8 weight-only representation models/quant.py
+    emits: ``{"q": int8[..., out], "scale": f32[out]}``."""
+    return isinstance(w, dict) and "q" in w and "scale" in w
+
+
+def dequant_weight(w: Dict, dtype=None) -> jax.Array:
+    """int8 weight dict -> dense kernel in ``dtype`` (default f32).
+    The multiply runs in f32 — scales are exact f32 per output channel
+    — and casts once at the end; inside a jitted apply this is the
+    dequant-in-matmul pattern: the bytes streamed from HBM are the int8
+    ``q``, the f32/bf16 kernel exists only as a fused temporary."""
+    kernel = w["q"].astype(jnp.float32) * w["scale"]
+    return kernel.astype(dtype) if dtype is not None else kernel
+
+
+def weight(w, dtype=None) -> jax.Array:
+    """The one idiom every matmul site fetches its kernel through: a
+    plain array casts to ``dtype`` (a no-op everywhere params were
+    already cast), an int8 weight dict dequantizes in place
+    (models/quant.py)."""
+    if is_quantized_weight(w):
+        return dequant_weight(w, dtype)
+    return w.astype(dtype) if dtype is not None else w
+
+
 def dense(p: Dict, x: jax.Array) -> jax.Array:
-    return x @ p["kernel"] + p["bias"]
+    return x @ weight(p["kernel"], x.dtype) + p["bias"]
 
 
 def dropout(rng, x: jax.Array, rate: float) -> jax.Array:
@@ -45,8 +71,15 @@ def layernorm_params(dim: int, dtype=jnp.float32) -> Dict:
 
 
 def cast_tree(tree, dtype):
-    """Cast every float leaf to ``dtype`` (int leaves untouched)."""
-    return jax.tree.map(
-        lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
-        tree,
-    )
+    """Cast every float leaf to ``dtype`` (int leaves untouched).
+    Quantized weight dicts pass through whole: their int8 payload is
+    already the storage format and their f32 scales must STAY f32 —
+    dequantization casts to the compute dtype at the use site
+    (``weight``)."""
+
+    def cast(a):
+        if is_quantized_weight(a):
+            return a
+        return a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a
+
+    return jax.tree.map(cast, tree, is_leaf=is_quantized_weight)

@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from roko_tpu.models.layers import dropout as _dropout
+from roko_tpu.models.layers import dropout as _dropout, weight as _weight
 
 
 def lingru_layer_params(
@@ -65,8 +65,9 @@ def lingru_gates(
     """``x`` [..., in] -> the affine-recurrence coefficients
     ``(a, b)`` with ``h_t = a_t * h_{t-1} + b_t``. One fused [in, 2H]
     matmul for both gates."""
-    hidden = params["w_zx"].shape[1]
-    w = jnp.concatenate([params["w_zx"], params["w_cx"]], axis=1)
+    w_zx = _weight(params["w_zx"], x.dtype)
+    hidden = w_zx.shape[1]
+    w = jnp.concatenate([w_zx, _weight(params["w_cx"], x.dtype)], axis=1)
     bias = jnp.concatenate([params["b_z"], params["b_c"]])
     proj = x @ w + bias
     z = jax.nn.sigmoid(proj[..., :hidden])
@@ -128,11 +129,15 @@ def bidir_lingru_layer(layer: Dict[str, Any], x: jax.Array) -> jax.Array:
     directions; the backward direction's coefficients are time-reversed
     so a SINGLE associative scan (directions stacked as a leading
     batch dim) solves both recurrences at once."""
-    hidden = layer["fwd"]["w_zx"].shape[1]
+    # weight() dequantizes int8 weight-only kernels in place
+    # (models/quant.py); plain f32/bf16 kernels pass through untouched
+    w_zx_f = _weight(layer["fwd"]["w_zx"], x.dtype)
+    hidden = w_zx_f.shape[1]
     w4 = jnp.concatenate(
         [
-            layer["fwd"]["w_zx"], layer["fwd"]["w_cx"],
-            layer["bwd"]["w_zx"], layer["bwd"]["w_cx"],
+            w_zx_f, _weight(layer["fwd"]["w_cx"], x.dtype),
+            _weight(layer["bwd"]["w_zx"], x.dtype),
+            _weight(layer["bwd"]["w_cx"], x.dtype),
         ],
         axis=1,
     )

@@ -44,6 +44,7 @@ from roko_tpu.models.layers import (
     dense as _dense,
     dense_params as _dense_params,
     dropout as _dropout,
+    weight as _weight,
 )
 
 Params = Dict[str, Any]
@@ -57,7 +58,11 @@ class RokoModel:
         """``attn_fn`` injects a custom attention (e.g. the ring
         sequence-parallel one from roko_tpu/parallel/ring.py) into the
         transformer variant; None uses dense attention."""
-        self.cfg = cfg or ModelConfig()
+        # "auto" resolves to the live backend's default here — bf16 on
+        # TPU, f32 elsewhere (config.default_compute_dtype) — so apply,
+        # the AOT bundle identity, and the bench suites all agree on
+        # the concrete dtype
+        self.cfg = (cfg or ModelConfig()).resolve()
         self.attn_fn = attn_fn
         if self.cfg.kind not in ("gru", "lingru", "transformer"):
             raise ValueError(f"unknown model kind: {self.cfg.kind}")
@@ -104,6 +109,14 @@ class RokoModel:
             from roko_tpu.models.transformer import transformer_init
 
             params["encoder"] = transformer_init(keys[4], cfg)
+        if cfg.quantize is not None:
+            # a quantized config's NATIVE tree is the quantized one:
+            # `roko-tpu compile --quantize int8` lowers against this
+            # structure (eval_shape — quantization is traceable), and
+            # tests/bench init real quantized params the same way
+            from roko_tpu.models.quant import quantize_params
+
+            params = quantize_params(params, cfg)
         return params
 
     # -- forward ------------------------------------------------------------
@@ -143,7 +156,9 @@ class RokoModel:
                     "brtv,vd->brtd", onehot, p_sub["embedding"]
                 )  # [B,200,90,50]
                 e = _dropout(r0, e, cfg.dropout)
-                h = jnp.einsum("brtd,rj->btdj", e, p_sub["fc1"]["kernel"])
+                h = jnp.einsum(
+                    "brtd,rj->btdj", e, _weight(p_sub["fc1"]["kernel"], dtype)
+                )
                 h = jax.nn.relu(h + p_sub["fc1"]["bias"])
                 h = _dropout(r1, h, cfg.dropout)
                 h = jax.nn.relu(_dense(p_sub["fc2"], h))
@@ -171,7 +186,8 @@ class RokoModel:
             # summation order; only valid without the per-element dropout
             # between embed and fc1, hence inference-only.
             onehot = jax.nn.one_hot(x, cfg.embed_vocab, dtype=dtype)
-            w1 = params["fc1"]["kernel"].astype(dtype)  # [200, J]
+            # weight() dequantizes an int8 weight-only kernel in place
+            w1 = _weight(params["fc1"]["kernel"], dtype)  # [200, J]
             # contract the read axis first: [B,T,V,J]
             m = jnp.einsum("brtv,rj->btvj", onehot, w1)
             emb = params["embedding"].astype(dtype)  # [V, D]

@@ -1,0 +1,139 @@
+"""Weight-only int8 quantization for the consensus models.
+
+The serving model is a stack of large batched matmuls that are
+memory-bound at serving batch sizes: every predict step streams each
+weight matrix from HBM once per dispatch, while the MXU/ALUs wait.
+Weight-only quantization attacks exactly those bytes — each matmul
+kernel ``w: f32[in, out]`` is stored as
+
+    q:     int8[in, out]          round(w / scale), clipped to ±127
+    scale: f32[out]               max(|w|, axis=0) / 127  (per OUTPUT channel)
+
+and dequantized *inside* the compiled predict program right where it
+feeds its matmul (``dequant_weight`` — the ``weight()`` helper in
+models/layers.py is the one use-site idiom), so the bytes that move are
+int8, not f32. Everything else stays float: activations, biases, the
+(12-row) embedding, recurrence state, and the final logits — this is
+the standard weight-only recipe, which keeps the numerics close enough
+that the held-out-Q gate (polished Q within 0.5 of the f32 reference,
+tests/test_precision.py slow lane) holds.
+
+Quantization is CONVERSION-TIME only: training always runs full
+precision (training/loop.py refuses a quantized config), and the f32
+checkpoint is quantized when loaded for inference/serve
+(``maybe_quantize``) or when ``roko-tpu compile --quantize int8``
+builds an AOT bundle. The bundle identity digest covers
+``ModelConfig.quantize``, so a quantized bundle refuses to load into a
+plain session (and vice versa) with the usual field-naming
+:class:`~roko_tpu.compile.BundleMismatch` diff.
+
+Targeted kernels (per-output-channel on the LAST axis, which is the
+output-channel axis for every one of them):
+
+- front end + head: ``fc1.kernel``, ``fc2.kernel``, ``head.kernel``
+- ``kind="gru"``:    per layer/direction ``w_ih`` [in,3H], ``w_hh`` [H,3H]
+- ``kind="lingru"``: per layer/direction ``w_zx`` [in,H], ``w_cx`` [in,H]
+
+The transformer variant has no int8 path (ModelConfig refuses the
+combination at construction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from roko_tpu.config import ModelConfig
+from roko_tpu.models.layers import dequant_weight, is_quantized_weight
+
+Params = Dict[str, Any]
+
+#: symmetric int8 range; ±127 (not -128) keeps the scale symmetric so
+#: dequantization is a single multiply
+QMAX = 127.0
+
+#: kernel key names quantized per model sub-tree (biases and scales in
+#: the same dicts stay f32)
+_DENSE_KERNELS = ("fc1", "fc2", "head")
+_GRU_KERNELS = ("w_ih", "w_hh")
+_LINGRU_KERNELS = ("w_zx", "w_cx")
+
+
+def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
+    """One f32 kernel -> ``{"q": int8, "scale": f32[out]}`` with
+    per-output-channel (last axis) absmax scales. Traceable — runs
+    under ``jax.eval_shape`` so AOT bundle export needs no real
+    checkpoint."""
+    absmax = jnp.max(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    # an all-zero channel would divide 0/0; its q rows are 0 either way
+    scale = jnp.where(absmax > 0, absmax, 1.0).astype(jnp.float32) / QMAX
+    q = jnp.clip(jnp.round(w / scale), -QMAX, QMAX).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def _quantize_recurrence(layers, kernel_names) -> Any:
+    out = []
+    for layer in layers:
+        new_layer = {}
+        for direction, p in layer.items():
+            new_layer[direction] = {
+                k: quantize_weight(v) if k in kernel_names else v
+                for k, v in p.items()
+            }
+        out.append(new_layer)
+    return tuple(out)
+
+
+def quantize_params(params: Params, cfg: ModelConfig) -> Params:
+    """f32 param pytree -> the quantized serving tree for ``cfg``
+    (``cfg.quantize`` must be "int8"). Embedding, biases, and anything
+    not a targeted matmul kernel pass through untouched."""
+    if cfg.quantize != "int8":
+        raise ValueError(f"unsupported quantize mode {cfg.quantize!r}")
+    out = dict(params)
+    for name in _DENSE_KERNELS:
+        if name in out:
+            out[name] = dict(
+                out[name], kernel=quantize_weight(out[name]["kernel"])
+            )
+    if "gru" in out:
+        out["gru"] = _quantize_recurrence(out["gru"], _GRU_KERNELS)
+    if "lingru" in out:
+        out["lingru"] = _quantize_recurrence(out["lingru"], _LINGRU_KERNELS)
+    return out
+
+
+def is_quantized(params: Params) -> bool:
+    """True when ``params`` already carries int8 weight dicts (any
+    targeted kernel suffices — quantization is all-or-nothing per
+    tree)."""
+    for name in _DENSE_KERNELS:
+        if name in params and is_quantized_weight(params[name].get("kernel")):
+            return True
+    return False
+
+
+def maybe_quantize(params: Params, cfg: ModelConfig) -> Params:
+    """The one conversion gate every inference/serve path loads params
+    through: quantizes when ``cfg.quantize`` asks for it, is a no-op
+    when quantization is off or the tree is already quantized (so a
+    session handed pre-converted params never double-quantizes)."""
+    if cfg.quantize is None or is_quantized(params):
+        return params
+    return quantize_params(params, cfg)
+
+
+def dequantize_params(params: Params, dtype=jnp.float32) -> Params:
+    """Back to a dense float tree (every int8 weight dict replaced by
+    its dequantized kernel in ``dtype``). Used by apply paths that need
+    plain arrays — e.g. the fused Pallas GRU kernels — and by tests
+    bounding the quantization error."""
+    return jax.tree.map(
+        lambda leaf: dequant_weight(leaf, dtype)
+        if is_quantized_weight(leaf)
+        else leaf,
+        params,
+        is_leaf=is_quantized_weight,
+    )

@@ -426,6 +426,12 @@ def run_streaming_polish(
     # program)
     enable_persistent_cache(cfg.compile)
     model = RokoModel(cfg.model)
+    # conversion-time weight-only quantization (models/quant.py), as
+    # run_inference/PolishSession: raw f32 params convert here when the
+    # config asks; already-quantized params pass through
+    from roko_tpu.models.quant import maybe_quantize
+
+    params = maybe_quantize(params, model.cfg)
     params_host = params  # kept host-side for the CPU hang fail-over
     params = jax.device_put(params, replicated_sharding(mesh))
     predict = make_predict_step(model, mesh)

@@ -82,6 +82,13 @@ class PolishSession:
             )
         self.ladder: Tuple[int, ...] = rungs
         self.model = RokoModel(self.cfg.model)
+        # conversion-time weight-only quantization (models/quant.py):
+        # the f32 checkpoint quantizes ONCE at session build; the
+        # device then holds int8 kernels + f32 scales, and every
+        # dispatch dequantizes in-program (weight-bytes 4x smaller)
+        from roko_tpu.models.quant import maybe_quantize
+
+        params = maybe_quantize(params, self.model.cfg)
         self.resilience = self.cfg.resilience
         # host-side params copy for the CPU hang fail-over (taken now,
         # while the device is known-good; after a hang a device_get of

@@ -337,6 +337,16 @@ def train(
     from roko_tpu.training import guard as guard_lib
 
     distributed.initialize()  # no-op single host (SURVEY §5.8)
+    if cfg.model.quantize is not None:
+        # quantization is CONVERSION-TIME only (docs/TRAINING.md):
+        # training runs full precision (f32 or bf16 compute) and the
+        # int8 conversion happens when the checkpoint is loaded for
+        # inference/serve or AOT-compiled (`roko-tpu compile --quantize`)
+        raise ValueError(
+            f"quantize={cfg.model.quantize!r} is an inference-only "
+            "conversion: train full precision, then quantize at load "
+            "time (--quantize int8 on inference/polish/serve/compile)"
+        )
     if not distributed.is_primary():
         log = lambda s: None  # noqa: E731 — primary-only logging
     tcfg = cfg.train

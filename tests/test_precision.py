@@ -1,0 +1,556 @@
+"""Reduced-precision inference plane (ISSUE 11): bf16 as a first-class
+compute dtype + int8 weight-only quantized bundles.
+
+Contracts pinned here:
+
+1. **Config/CLI validation**: unknown ``compute_dtype`` / ``quantize``
+   modes fail at CONSTRUCTION (config layering, JSON load, CLI), the
+   transformer+int8 combination refuses, and training refuses a
+   quantized config (quantization is conversion-time only).
+2. **Quantization math** (models/quant.py): per-output-channel f32
+   scales, int8 payloads, embedding/biases untouched, per-element
+   dequant error bounded by scale/2, idempotent ``maybe_quantize``.
+3. **Precision identity drift**: bf16 and int8 AOT bundle round-trips
+   are byte-identical to their own jit path; an f32<->bf16 or
+   plain<->int8 digest mismatch refuses naming the differing field
+   (``model.compute_dtype`` / ``model.quantize``).
+4. **Backend defaults**: ``compute_dtype="auto"`` resolves through
+   ``config.default_compute_dtype`` — bf16 on TPU, f32 elsewhere — and
+   the resolved value (not "auto") is what the bundle identity digests.
+5. **Slow lane** (CI precision-gate): train f32 once, then polish with
+   bf16 compute and with int8 weight-only params — each held-out Q
+   within 0.5 of the f32 reference (the lingru gate's discipline).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    CompileConfig,
+    MeshConfig,
+    ModelConfig,
+    RokoConfig,
+    ServeConfig,
+    TrainConfig,
+    default_compute_dtype,
+)
+from roko_tpu.models import RokoModel
+from roko_tpu.models.quant import (
+    dequantize_params,
+    is_quantized,
+    maybe_quantize,
+    quantize_params,
+    quantize_weight,
+)
+
+TINY = ModelConfig(
+    kind="gru", embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=2
+)
+TINY_LIN = dataclasses.replace(TINY, kind="lingru")
+TINY_BF16 = dataclasses.replace(TINY, compute_dtype="bfloat16")
+TINY_INT8 = dataclasses.replace(TINY, quantize="int8")
+
+SERVE = RokoConfig(
+    model=TINY, mesh=MeshConfig(dp=8), serve=ServeConfig(ladder=(8,))
+)
+
+
+def _serve_cfg(model: ModelConfig) -> RokoConfig:
+    return dataclasses.replace(SERVE, model=model)
+
+
+# -- config + CLI validation --------------------------------------------------
+
+
+def test_config_rejects_unknown_compute_dtype():
+    with pytest.raises(ValueError, match="unknown compute_dtype"):
+        ModelConfig(compute_dtype="float16")
+    with pytest.raises(ValueError, match="unknown compute_dtype"):
+        RokoConfig.from_json('{"model": {"compute_dtype": "fp8"}}')
+
+
+def test_config_rejects_unknown_quantize_mode():
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        ModelConfig(quantize="int4")
+    with pytest.raises(ValueError, match="unknown quantize mode"):
+        RokoConfig.from_json('{"model": {"quantize": "w8a8"}}')
+
+
+def test_config_rejects_transformer_quantize():
+    with pytest.raises(ValueError, match="transformer"):
+        ModelConfig(kind="transformer", quantize="int8")
+
+
+def test_config_json_roundtrip_preserves_precision_fields():
+    cfg = RokoConfig(
+        model=ModelConfig(compute_dtype="bfloat16", quantize="int8")
+    )
+    loaded = RokoConfig.from_json(cfg.to_json()).model
+    assert loaded.compute_dtype == "bfloat16"
+    assert loaded.quantize == "int8"
+
+
+def test_default_compute_dtype_policy():
+    assert default_compute_dtype("tpu") == "bfloat16"
+    assert default_compute_dtype("cpu") == "float32"
+    assert default_compute_dtype("gpu") == "float32"
+    # the test env pins JAX_PLATFORMS=cpu: auto resolves to f32 at
+    # model construction, and the resolved (never "auto") dtype is what
+    # apply/digest see
+    assert ModelConfig().compute_dtype == "auto"
+    assert RokoModel(ModelConfig()).cfg.compute_dtype == "float32"
+    assert ModelConfig().resolve("tpu").compute_dtype == "bfloat16"
+    # explicit dtypes never re-resolve
+    assert TINY_BF16.resolve("cpu").compute_dtype == "bfloat16"
+
+
+@pytest.mark.parametrize(
+    "argv",
+    [
+        ["inference", "d.hdf5", "ckpt", "out.fa", "--quantize", "int8"],
+        ["polish", "r.fa", "x.bam", "ckpt", "o.fa", "--quantize", "int8"],
+        ["compile", "bundle", "--quantize", "int8"],
+        ["serve", "ckpt", "--quantize", "int8"],
+    ],
+    ids=["inference", "polish", "compile", "serve"],
+)
+def test_cli_quantize_flag_reaches_config(argv):
+    from roko_tpu.cli import _build_config, build_parser
+
+    args = build_parser().parse_args(argv)
+    assert _build_config(args).model.quantize == "int8"
+
+
+def test_cli_quantize_none_clears_config_file(tmp_path):
+    from roko_tpu.cli import _build_config, build_parser
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(RokoConfig(model=TINY_INT8).to_json())
+    args = build_parser().parse_args(
+        ["serve", "ckpt", "--config", str(cfg_path), "--quantize", "none"]
+    )
+    assert _build_config(args).model.quantize is None
+    # and without the override the file's setting sticks
+    args = build_parser().parse_args(
+        ["serve", "ckpt", "--config", str(cfg_path)]
+    )
+    assert _build_config(args).model.quantize == "int8"
+
+
+def test_cli_compute_dtype_choices():
+    from roko_tpu.cli import _build_config, build_parser
+
+    for dtype in ("auto", "float32", "bfloat16"):
+        args = build_parser().parse_args(
+            ["serve", "ckpt", "--compute-dtype", dtype]
+        )
+        assert _build_config(args).model.compute_dtype == dtype
+
+
+def test_train_refuses_quantized_config(tmp_path):
+    from roko_tpu.training.loop import train
+
+    cfg = RokoConfig(model=TINY_INT8, train=TrainConfig(batch_size=8))
+    with pytest.raises(ValueError, match="conversion"):
+        train(cfg, str(tmp_path / "x.hdf5"), str(tmp_path / "out"))
+
+
+# -- quantization math --------------------------------------------------------
+
+
+def test_quantize_weight_per_channel_scales(rng):
+    w = jnp.asarray(rng.standard_normal((20, 6)), jnp.float32) * jnp.asarray(
+        [0.1, 1.0, 10.0, 0.01, 5.0, 0.5]
+    )
+    q = quantize_weight(w)
+    assert q["q"].dtype == jnp.int8 and q["q"].shape == w.shape
+    assert q["scale"].dtype == jnp.float32 and q["scale"].shape == (6,)
+    # per-OUTPUT-channel: each column's scale tracks that column's absmax
+    np.testing.assert_allclose(
+        np.asarray(q["scale"]), np.abs(np.asarray(w)).max(axis=0) / 127.0
+    )
+    # dequant error bounded by half a quantization step per element
+    deq = np.asarray(q["q"], np.float32) * np.asarray(q["scale"])
+    err = np.abs(deq - np.asarray(w))
+    assert (err <= np.asarray(q["scale"]) / 2 + 1e-7).all()
+
+
+def test_quantize_weight_zero_channel_safe():
+    w = jnp.zeros((4, 3), jnp.float32)
+    q = quantize_weight(w)
+    assert np.asarray(q["q"]).max() == 0
+    assert np.isfinite(np.asarray(q["scale"])).all()
+
+
+@pytest.mark.parametrize("cfg", [TINY, TINY_LIN], ids=["gru", "lingru"])
+def test_quantize_params_targets_matmul_kernels_only(cfg):
+    cfg8 = dataclasses.replace(cfg, quantize="int8")
+    params = RokoModel(cfg).init(jax.random.PRNGKey(0))
+    q = quantize_params(params, cfg8)
+    # embedding + every bias stay f32
+    assert q["embedding"].dtype == jnp.float32
+    for name in ("fc1", "fc2", "head"):
+        assert q[name]["kernel"]["q"].dtype == jnp.int8
+        assert q[name]["kernel"]["scale"].dtype == jnp.float32
+        assert q[name]["bias"].dtype == jnp.float32
+    rec = q["gru" if cfg.kind == "gru" else "lingru"]
+    kernels = ("w_ih", "w_hh") if cfg.kind == "gru" else ("w_zx", "w_cx")
+    for layer in rec:
+        for direction in ("fwd", "bwd"):
+            for k in kernels:
+                assert layer[direction][k]["q"].dtype == jnp.int8
+            for b in [k for k in layer[direction] if k.startswith("b")]:
+                assert layer[direction][b].dtype == jnp.float32
+    assert is_quantized(q) and not is_quantized(params)
+    # maybe_quantize: converts raw trees, passes converted ones through
+    assert maybe_quantize(params, cfg8) is not params
+    assert maybe_quantize(q, cfg8) is q
+    assert maybe_quantize(params, cfg) is params
+    # dequantize round-trip restores shapes and bounded values
+    deq = dequantize_params(q)
+    assert deq["fc1"]["kernel"].shape == params["fc1"]["kernel"].shape
+
+
+@pytest.mark.parametrize("cfg", [TINY, TINY_LIN], ids=["gru", "lingru"])
+def test_quantized_apply_close_to_f32(cfg, rng):
+    cfg8 = dataclasses.replace(cfg, quantize="int8")
+    model = RokoModel(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    x = rng.integers(
+        0, C.FEATURE_VOCAB, (3, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    ref = model.apply(params, x, deterministic=True)
+    out = RokoModel(cfg8).apply(
+        quantize_params(params, cfg8), x, deterministic=True
+    )
+    assert out.dtype == jnp.float32  # logits stay f32
+    delta = float(jnp.abs(ref - out).max())
+    assert 0 < delta < 0.5, delta  # differs (really int8) but close
+
+
+def test_quantized_model_init_is_quantized_tree():
+    m8 = RokoModel(TINY_INT8)
+    params = m8.init(jax.random.PRNGKey(0))
+    assert is_quantized(params)
+    # and eval_shape walks it (the AOT export path needs no checkpoint)
+    shapes = jax.eval_shape(m8.init, jax.random.PRNGKey(0))
+    assert shapes["fc1"]["kernel"]["q"].dtype == jnp.int8
+
+
+# -- serve session + precision identity drift ---------------------------------
+
+
+def test_polish_session_quantizes_raw_params_zero_recompiles():
+    from roko_tpu.serve import PolishSession
+
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    session = PolishSession(params, _serve_cfg(TINY_INT8))
+    session.warmup()
+    compiled = session.cache_size()
+    rng = np.random.default_rng(0)
+    for n in (3, 8):
+        preds = session.predict(
+            rng.integers(0, C.FEATURE_VOCAB, (n, 200, 90)).astype(np.uint8)
+        )
+        assert preds.shape == (n, C.WINDOW_COLS)
+    assert session.cache_size() == compiled
+    assert session.dispatched_shapes <= set(session.ladder)
+
+
+@pytest.fixture(scope="module")
+def bundles(tmp_path_factory):
+    """One bundle per precision variant of the SAME tiny gru model."""
+    from roko_tpu.compile import export_bundle
+
+    root = tmp_path_factory.mktemp("precision-bundles")
+    out = {}
+    for tag, model in (
+        ("f32", TINY), ("bf16", TINY_BF16), ("int8", TINY_INT8),
+    ):
+        out[tag] = str(root / tag)
+        export_bundle(
+            out[tag], _serve_cfg(model), ladder=(8,), log=lambda m: None
+        )
+    return out
+
+
+@pytest.mark.parametrize("tag,model", [("bf16", TINY_BF16), ("int8", TINY_INT8)])
+def test_precision_bundle_roundtrip_byte_identical(bundles, rng, tag, model):
+    """A bf16/int8 AOT bundle loads into its matching session with zero
+    jit compiles and byte-identical output to that session's own jit
+    path (the lingru bundle discipline, per precision variant)."""
+    from roko_tpu.serve import PolishSession
+
+    cfg = _serve_cfg(model)
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    jit_session = PolishSession(params, cfg, ladder=(8,))
+    jit_session.warmup()
+    aot_session = PolishSession(
+        params,
+        dataclasses.replace(cfg, compile=CompileConfig(bundle_dir=bundles[tag])),
+        ladder=(8,),
+    )
+    aot_session.warmup(log=None)
+    assert aot_session.warmup_report.mode == "aot"
+    assert aot_session.cache_size() == 0
+    x = rng.integers(0, C.FEATURE_VOCAB, (5, 200, 90)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        aot_session.predict(x), jit_session.predict(x)
+    )
+
+
+def test_bundle_digest_covers_compute_dtype(bundles):
+    """f32<->bf16 drift refuses naming model.compute_dtype, both ways."""
+    from roko_tpu.compile import BundleMismatch, load_bundle
+
+    with pytest.raises(BundleMismatch, match=r"model\.compute_dtype"):
+        load_bundle(bundles["bf16"], _serve_cfg(TINY), log=lambda m: None)
+    with pytest.raises(BundleMismatch, match="bfloat16"):
+        load_bundle(bundles["f32"], _serve_cfg(TINY_BF16), log=lambda m: None)
+
+
+def test_bundle_digest_covers_quantize(bundles):
+    """plain<->int8 drift refuses naming model.quantize, both ways."""
+    from roko_tpu.compile import BundleMismatch, load_bundle
+
+    with pytest.raises(BundleMismatch, match=r"model\.quantize"):
+        load_bundle(bundles["int8"], _serve_cfg(TINY), log=lambda m: None)
+    with pytest.raises(BundleMismatch, match=r"model\.quantize"):
+        load_bundle(bundles["f32"], _serve_cfg(TINY_INT8), log=lambda m: None)
+
+
+def test_auto_dtype_digest_equals_resolved_digest():
+    """An "auto" session and an explicit-f32 session on this (CPU)
+    backend share one digest — auto is resolved BEFORE digesting, so a
+    bundle built under auto loads into an explicit session and vice
+    versa."""
+    from roko_tpu.compile import bundle_digest, bundle_identity
+
+    auto = bundle_identity(_serve_cfg(dataclasses.replace(TINY, compute_dtype="auto")))
+    explicit = bundle_identity(
+        _serve_cfg(dataclasses.replace(TINY, compute_dtype="float32"))
+    )
+    assert auto["model"]["compute_dtype"] == "float32"
+    assert bundle_digest(auto) == bundle_digest(explicit)
+
+
+def test_cache_probe_prints_precision_identity(bundles):
+    """Operators tell precision variants apart from the one-line
+    inventory — no config hashing (ISSUE 11 satellite)."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [
+            sys.executable, "tools/cache_probe.py",
+            "--bundle", bundles["int8"], "--bundle", bundles["bf16"],
+        ],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=120,
+    )
+    assert r.returncode == 0
+    lines = r.stdout.strip().splitlines()
+    assert any("quantize=int8" in l and "compute_dtype=float32" in l for l in lines)
+    assert any("compute_dtype=bfloat16" in l and "quantize=none" in l for l in lines)
+
+
+def test_cli_compile_prints_precision_identity(tmp_path, capsys):
+    from roko_tpu.cli import main
+
+    cfg_path = tmp_path / "cfg.json"
+    cfg_path.write_text(_serve_cfg(TINY).to_json())
+    rc = main(
+        [
+            "compile", str(tmp_path / "bundle"), "--config", str(cfg_path),
+            "--ladder", "8", "--quantize", "int8", "--no-verify",
+        ]
+    )
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "quantize=int8" in out and "compute_dtype=float32" in out
+
+
+def test_run_inference_quantizes_raw_params(tmp_path, rng):
+    """The batch path converts a raw f32 checkpoint at load time: int8
+    inference through run_inference produces a valid polish and is
+    deterministic with the session path on the same windows."""
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.infer import run_inference
+    from roko_tpu.serve import PolishSession
+
+    n = 6
+    X = rng.integers(
+        0, C.FEATURE_VOCAB, (n, C.WINDOW_ROWS, C.WINDOW_COLS)
+    ).astype(np.uint8)
+    draft = "ACGT" * ((n * C.WINDOW_STRIDE + C.WINDOW_COLS) // 4 + 8)
+    pos = [
+        np.stack(
+            [np.arange(i * 30, i * 30 + C.WINDOW_COLS), np.zeros(C.WINDOW_COLS)], 1
+        ).astype(np.int64)
+        for i in range(n)
+    ]
+    h5 = str(tmp_path / "infer.hdf5")
+    with DataWriter(h5, infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", pos, list(X), None)
+    cfg = _serve_cfg(TINY_INT8)
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    polished = run_inference(
+        h5, params, cfg, batch_size=8, log=lambda s: None
+    )
+    assert set(polished) == {"ctg"}
+    session = PolishSession(params, cfg, ladder=(8,))
+    session.warmup()
+    preds = session.predict(X)
+    assert preds.shape == (n, C.WINDOW_COLS)
+
+
+# -- benchmark companions -----------------------------------------------------
+
+
+def test_model_param_bytes_int8_cuts_kernel_bytes():
+    from roko_tpu import benchmark as B
+
+    for cfg in (TINY, TINY_LIN, ModelConfig(), ModelConfig(kind="lingru")):
+        full = B.model_param_bytes(cfg)
+        q = B.model_param_bytes(dataclasses.replace(cfg, quantize="int8"))
+        # kernels dominate: int8 must land well under half of f32 and
+        # above a quarter (scales + f32 embedding/biases keep it > 1/4)
+        assert full / 4 < q < full / 2, (cfg.kind, full, q)
+        # bf16 is a compute cast, NOT a storage cut
+        assert B.model_param_bytes(
+            dataclasses.replace(cfg, compute_dtype="bfloat16")
+        ) == full
+    assert B.model_param_bytes_per_window(TINY, 128) == pytest.approx(
+        B.model_param_bytes(TINY) / 128
+    )
+
+
+def test_bench_precision_reports_int8_column():
+    from roko_tpu import benchmark as B
+
+    row = B.bench_precision(
+        "lingru", 4, 2,
+        model_overrides=dict(
+            embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1
+        ),
+    )
+    assert row["int8_windows_per_sec"] > 0
+    assert 0 < row["int8_max_abs_logit_delta"] < 1.0
+    assert row["int8_param_bytes_per_window"] < row["f32_param_bytes_per_window"]
+    assert row["int8_flops_per_param_byte"] > row["f32_flops_per_param_byte"]
+
+
+def test_compare_to_previous_covers_precision_rows():
+    from roko_tpu import benchmark as B
+
+    def artifact(i8):
+        return {
+            "value": 1.0,
+            "vs_baseline": 1.0,
+            "detail": {
+                "iterations": 20,
+                "precision": {
+                    "gru": {
+                        "f32_windows_per_sec": 100.0,
+                        "bf16_windows_per_sec": 100.0,
+                        "int8_windows_per_sec": i8,
+                    }
+                },
+            },
+        }
+
+    block = B.compare_to_previous(artifact(70.0), artifact(100.0))
+    row = block["metrics"]["precision.gru.int8_windows_per_sec"]
+    assert row["regression"] is True and row["noise"] is False
+    assert block["metrics"]["precision.gru.f32_windows_per_sec"]["noise"] is True
+
+
+# -- slow lane: the held-out-Q precision gate ---------------------------------
+
+
+@pytest.mark.slow
+def test_precision_q_within_half_of_f32_reference(tmp_path):
+    """The accuracy gate behind the speed claim (CI precision-gate
+    lane): ONE f32 training run, then the same checkpoint polished
+    three ways — f32 (reference), bf16 compute, int8 weight-only — and
+    the reduced-precision held-out Qs must land within 0.5 of the f32
+    reference while all three genuinely polish (error rate below the
+    draft's). Same discipline as the lingru Q gate."""
+    from roko_tpu.eval.assess import assess_pair
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.infer import run_inference
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+    from roko_tpu.training.loop import train
+    from tests.helpers import make_record
+    from tests.test_end_to_end import _build_genome
+
+    truth_a, draft_a, cig_a, reads_a = _build_genome(1, 9000, "train", hp=True)
+    write_fasta(str(tmp_path / "a.fasta"), [("train", draft_a)])
+    write_sorted_bam(str(tmp_path / "a.bam"), [("train", len(draft_a))], reads_a)
+    truth_rec = make_record("truth", 0, 0, truth_a, cig_a)
+    write_sorted_bam(
+        str(tmp_path / "a_truth.bam"), [("train", len(draft_a))], [truth_rec]
+    )
+    run_features(
+        str(tmp_path / "a.fasta"), str(tmp_path / "a.bam"),
+        str(tmp_path / "train.hdf5"), bam_y=str(tmp_path / "a_truth.bam"),
+        seed=3,
+    )
+    truth_b, draft_b, _, reads_b = _build_genome(2, 6000, "eval", hp=True)
+    write_fasta(str(tmp_path / "b.fasta"), [("eval", draft_b)])
+    write_sorted_bam(str(tmp_path / "b.bam"), [("eval", len(draft_b))], reads_b)
+    run_features(
+        str(tmp_path / "b.fasta"), str(tmp_path / "b.bam"),
+        str(tmp_path / "infer.hdf5"), seed=4,
+    )
+
+    base_model = ModelConfig(
+        kind="gru", embed_dim=32, read_mlp=(64, 8),
+        hidden_size=64, num_layers=2, compute_dtype="float32",
+    )
+    cfg = RokoConfig(
+        model=base_model,
+        train=TrainConfig(batch_size=64, epochs=10, lr=1.5e-3, patience=10),
+        mesh=MeshConfig(dp=8),
+    )
+    state = train(
+        cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=lambda s: None,
+    )
+    params = jax.device_get(state.params)
+    draft_res = assess_pair(
+        truth_b.encode(), draft_b.encode(), truth_name="eval"
+    )
+
+    qs = {}
+    variants = {
+        "f32": base_model,
+        "bf16": dataclasses.replace(base_model, compute_dtype="bfloat16"),
+        "int8": dataclasses.replace(base_model, quantize="int8"),
+    }
+    for tag, model in variants.items():
+        polished = run_inference(
+            str(tmp_path / "infer.hdf5"),
+            params,
+            dataclasses.replace(cfg, model=model),
+            batch_size=64,
+            log=lambda s: None,
+        )["eval"]
+        res = assess_pair(
+            truth_b.encode(), polished.encode(), truth_name="eval"
+        )
+        assert res.error_rate < draft_res.error_rate, (tag, res, draft_res)
+        # cap: a perfect polish has infinite Q; compare on a bounded scale
+        qs[tag] = min(res.qscore, 60.0)
+    assert qs["bf16"] >= qs["f32"] - 0.5, qs
+    assert qs["int8"] >= qs["f32"] - 0.5, qs

@@ -53,12 +53,17 @@ def main() -> int:
             print(f"bundle: {bundle} INVALID — {e}")
             continue
         ident = man.get("identity", {})
-        # kind rides in the digested ModelConfig: operators can tell at a
-        # glance which model family a cached bundle belongs to (a
-        # mismatched kind refuses to load — docs/SERVING.md)
-        kind = (ident.get("model") or {}).get("kind", "?")
+        # kind + precision ride in the digested ModelConfig: operators
+        # can tell at a glance which model family AND precision variant
+        # (f32/bf16 compute, int8 weight-only) a cached bundle belongs
+        # to without hashing configs (a mismatch on any of the three
+        # refuses to load — docs/SERVING.md "Precision")
+        model = ident.get("model") or {}
+        kind = model.get("kind", "?")
         print(
             f"bundle: {bundle} kind={kind} "
+            f"compute_dtype={model.get('compute_dtype', '?')} "
+            f"quantize={model.get('quantize') or 'none'} "
             f"digest={man.get('digest', '?')[:12]} "
             f"rungs={man.get('rungs')} backend={ident.get('backend')}/"
             f"{ident.get('device_kind')} jax={ident.get('jax_version')}"
