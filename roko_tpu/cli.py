@@ -316,6 +316,9 @@ def _build_config(args: argparse.Namespace):
         base.fleet,
         workers="workers", devices_per_worker="devices_per_worker",
         heartbeat_interval_s="heartbeat_interval",
+        registry_dir="registry", bake_s="bake_s",
+        rollback_error_pct="rollback_error_pct",
+        rollback_p99_x="rollback_p99_x",
     )
     compile_cfg = over(
         base.compile,
@@ -641,6 +644,29 @@ def cmd_compile(args: argparse.Namespace) -> int:
             os.unlink(os.path.join(args.out, BUNDLE_MANIFEST))
             return 1
         print(f"compile: {r.stdout.strip()} (fresh process)")
+    if args.register:
+        # registration AFTER verification: the registry must never name
+        # a bundle that has not proven loadable in a fresh process
+        from roko_tpu.serve.registry import (
+            RegistryError,
+            register_model,
+            resolve_registry_dir,
+        )
+
+        try:
+            register_model(
+                # --registry > the --config file's fleet.registry_dir >
+                # default (env ROKO_REGISTRY overrides all — the same
+                # layering the serve-side rollout resolver uses)
+                resolve_registry_dir(args.registry or cfg.fleet.registry_dir),
+                args.register,
+                args.out,
+                params_path=args.params,
+                force=args.force,
+            )
+        except RegistryError as e:
+            print(f"compile: registration refused — {e}", file=sys.stderr)
+            return 1
     return 0
 
 
@@ -721,6 +747,124 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if warm_error:
         raise SystemExit(f"serve: warmup failed: {warm_error[0]}")
     return 0
+
+
+def cmd_rollout(args: argparse.Namespace) -> int:
+    """Drive a RUNNING fleet supervisor onto a registered model version
+    (docs/SERVING.md "Model lifecycle"): POST /rollout, then poll
+    GET /rollout printing state transitions until the rollout lands
+    (exit 0) or rolls back / fails (exit 1). The supervisor does the
+    work — one worker at a time, health-gated, journaled — so this
+    command is safe to Ctrl-C and re-observe."""
+    import json
+    import time
+    import urllib.error
+    import urllib.request
+
+    url = args.url.rstrip("/")
+    payload = {"name": args.name}
+    for key, val in (
+        ("bake_s", args.bake_s),
+        ("rollback_error_pct", args.rollback_error_pct),
+        ("rollback_p99_x", args.rollback_p99_x),
+    ):
+        if val is not None:
+            payload[key] = val
+    req = urllib.request.Request(
+        url + "/rollout",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    try:
+        # generous: the supervisor re-verifies the registered version
+        # (sha256 over every params file) before answering the POST
+        with urllib.request.urlopen(req, timeout=300) as r:
+            status = json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            msg = json.loads(body).get("error", "")
+        except ValueError:
+            msg = body[:200].decode(errors="replace")
+        print(f"rollout: refused (HTTP {e.code}): {msg}", file=sys.stderr)
+        return 1
+    except TimeoutError:
+        print(
+            f"rollout: the supervisor at {url} did not answer the "
+            "submission within 300s — it may still be verifying the "
+            f"version; observe with `roko-tpu rollout {args.name} --url "
+            f"{url}` or GET /rollout",
+            file=sys.stderr,
+        )
+        return 1
+    except OSError as e:
+        print(
+            f"rollout: no supervisor at {url} ({e}); start one with "
+            "`roko-tpu serve CKPT --workers N`",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"rollout: {status['from_version']} -> {status['to_version']} "
+        f"accepted (bake {status['bake_s']:g}s, workers "
+        f"{status['workers']})"
+    )
+    if args.no_wait:
+        return 0
+    deadline = time.monotonic() + args.timeout
+    last = None
+    while time.monotonic() < deadline:
+        time.sleep(1.0)
+        try:
+            with urllib.request.urlopen(url + "/rollout", timeout=30) as r:
+                status = json.loads(r.read())
+        except (OSError, ValueError):
+            continue  # transient scrape failure; the supervisor journals
+        snap = (status.get("state"), tuple(status.get("workers_done", [])))
+        if snap != last:
+            last = snap
+            reason = status.get("reason")
+            print(
+                f"rollout: state={status.get('state')} "
+                f"done={status.get('workers_done')} "
+                f"versions={status.get('worker_versions')}"
+                + (f" reason={reason!r}" if reason else "")
+            )
+        if status.get("state") == "done":
+            print(f"rollout: complete — fleet on {status['to_version']}")
+            return 0
+        if status.get("state") == "idle":
+            # a supervisor restarted mid-watch reports idle even when
+            # its recovery FINALIZED the rollout — ask the fleet what
+            # it actually runs before declaring failure
+            try:
+                with urllib.request.urlopen(
+                    url + "/healthz", timeout=30
+                ) as r:
+                    live = json.loads(r.read()).get("version")
+            except (OSError, ValueError):
+                live = None
+            if live == args.name:
+                print(
+                    f"rollout: complete — fleet on {args.name} "
+                    "(finalized across a supervisor restart)"
+                )
+                return 0
+        if status.get("state") in ("rolled_back", "failed", "idle"):
+            print(
+                f"rollout: NOT applied (state={status.get('state')}"
+                + (f", reason={status.get('reason')!r})" if status.get("reason") else ")"),
+                file=sys.stderr,
+            )
+            return 1
+    print(
+        f"rollout: still {status.get('state')!r} after {args.timeout:g}s "
+        "of watching; the supervisor keeps going — re-observe with "
+        f"`roko-tpu rollout {args.name} --url {url}`",
+        file=sys.stderr,
+    )
+    return 1
 
 
 def cmd_sim(args: argparse.Namespace) -> int:
@@ -926,6 +1070,28 @@ def build_parser() -> argparse.ArgumentParser:
         "bundle (the check catches stub bundles a same-process load "
         "cannot)",
     )
+    p.add_argument(
+        "--register", default=None, metavar="NAME",
+        help="after verification, register the bundle in the model "
+        "registry under this version name (rollout target for "
+        "`roko-tpu rollout NAME`; docs/SERVING.md 'Model lifecycle')",
+    )
+    p.add_argument(
+        "--params", default=None, metavar="CKPT",
+        help="with --register: pin this checkpoint's bytes (sha256 per "
+        "file) into the registered version; omitted = the version rolls "
+        "out against the fleet's incumbent checkpoint",
+    )
+    p.add_argument(
+        "--registry", default=None, metavar="DIR",
+        help="model registry directory (default ~/.cache/roko-tpu/"
+        "registry; env ROKO_REGISTRY overrides)",
+    )
+    p.add_argument(
+        "--force", action="store_true",
+        help="with --register: overwrite an existing version name whose "
+        "identity differs (refused by default)",
+    )
     _config_arg(p)
     _model_args(p)
     _mesh_args(p)
@@ -1122,6 +1288,30 @@ def build_parser() -> argparse.ArgumentParser:
         help="fleet mode: seconds between supervisor /healthz probes "
         "of each worker (default 2)",
     )
+    p.add_argument(
+        "--registry", default=None, metavar="DIR",
+        help="fleet mode: model registry directory rollouts resolve "
+        "version names against (default ~/.cache/roko-tpu/registry; "
+        "env ROKO_REGISTRY overrides)",
+    )
+    p.add_argument(
+        "--bake-s", type=float, default=None,
+        help="fleet mode: seconds each rolled worker must hold a "
+        "contiguous healthy stretch before the next is touched "
+        "(default 15; the rollout canary gate is judged over this "
+        "window)",
+    )
+    p.add_argument(
+        "--rollback-error-pct", type=float, default=None,
+        help="fleet mode: canary error %% over the bake window beyond "
+        "this (and beyond the incumbent baseline) auto-rolls the "
+        "fleet back (default 2)",
+    )
+    p.add_argument(
+        "--rollback-p99-x", type=float, default=None,
+        help="fleet mode: canary p99 beyond this multiple of the "
+        "incumbent's pre-rollout p99 auto-rolls back (default 3)",
+    )
     # fleet-internal plumbing (the supervisor passes these to its
     # children; automation may use --announce to learn a port-0 bind)
     p.add_argument("--worker-id", type=int, default=None,
@@ -1134,6 +1324,43 @@ def build_parser() -> argparse.ArgumentParser:
     _resilience_args(p, serve=True)
     _compile_args(p)
     p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser(
+        "rollout",
+        help="roll a RUNNING fleet supervisor onto a registered model "
+        "version, one worker at a time with a canary health gate and "
+        "automatic rollback (register versions with "
+        "`roko-tpu compile --register NAME`)",
+    )
+    p.add_argument("name", help="registered model version name")
+    p.add_argument(
+        "--url", default="http://127.0.0.1:8000",
+        help="fleet supervisor base URL (default http://127.0.0.1:8000)",
+    )
+    p.add_argument(
+        "--bake-s", type=float, default=None,
+        help="override the supervisor's per-worker healthy-stretch "
+        "bake window for this rollout",
+    )
+    p.add_argument(
+        "--rollback-error-pct", type=float, default=None,
+        help="override the canary error-rate rollback threshold (%%)",
+    )
+    p.add_argument(
+        "--rollback-p99-x", type=float, default=None,
+        help="override the canary p99-multiple rollback threshold",
+    )
+    p.add_argument(
+        "--no-wait", action="store_true",
+        help="submit and exit 0 immediately instead of watching the "
+        "rollout to completion",
+    )
+    p.add_argument(
+        "--timeout", type=float, default=3600.0,
+        help="seconds to watch before giving up (the supervisor keeps "
+        "rolling either way; default 3600)",
+    )
+    p.set_defaults(fn=cmd_rollout)
 
     p = sub.add_parser(
         "inspect", help="summarise a features HDF5 file or directory"

@@ -388,8 +388,29 @@ class FleetConfig:
     max_inflight: int = 0
     #: worker logs + port-announce files live here; None = a
     #: ``roko-fleet-<pid>`` directory under the system tmpdir (where CI
-    #: failure dumps look for surviving-worker stderr)
+    #: failure dumps look for surviving-worker stderr). The rollout
+    #: journal lives here too — pin this for rollout crash recovery to
+    #: survive a supervisor restart (docs/SERVING.md "Model lifecycle")
     runtime_dir: Optional[str] = None
+    #: model registry directory for `roko-tpu rollout` (named version ->
+    #: AOT bundle digest + params manifest, serve/registry.py); None =
+    #: ~/.cache/roko-tpu/registry, env ROKO_REGISTRY overrides both
+    registry_dir: Optional[str] = None
+    #: rollout canary bake: seconds a freshly rolled worker must hold a
+    #: CONTIGUOUS healthy (in-rotation) stretch before the next worker
+    #: is touched; the canary gate is judged over this window
+    bake_s: float = 15.0
+    #: rollback trigger: canary error percentage over the bake window
+    #: beyond this (and beyond the incumbent baseline) rolls the fleet
+    #: back to the incumbent version
+    rollback_error_pct: float = 2.0
+    #: rollback trigger: canary p99 beyond this multiple of the
+    #: incumbent's pre-rollout p99 rolls back
+    rollback_p99_x: float = 3.0
+    #: seconds a rolled worker gets to re-enter rotation (spawn + AOT
+    #: re-warm) before the rollout gives up and rolls back; generous —
+    #: a cold compile on a bundleless config legitimately takes minutes
+    rollout_ready_timeout_s: float = 900.0
 
 
 @dataclass(frozen=True)

@@ -26,12 +26,28 @@ Modules:
   restart backoff, restart-storm breaker) + failover routing
 - ``supervisor`` — the ``--workers N`` front end over a ``fleet``
   (admission control, rolling SIGTERM drain, metrics aggregation)
+- ``registry`` — named model versions: AOT bundle digest + params
+  manifest, written by ``roko-tpu compile --register``
+- ``rollout``  — health-gated zero-downtime rolling weight rollout
+  with automatic rollback and a crash-consistent journal
 """
 
 from roko_tpu.serve.batcher import Backpressure, MicroBatcher
 from roko_tpu.serve.client import PolishClient, ServerBusy, ServiceUnavailable
-from roko_tpu.serve.fleet import Fleet, WorkerHandle
+from roko_tpu.serve.fleet import Fleet, WorkerHandle, WorkerLaunchSpec
 from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.registry import (
+    RegistryError,
+    RegistryMismatch,
+    list_models,
+    register_model,
+    resolve_model,
+)
+from roko_tpu.serve.rollout import (
+    RolloutController,
+    RolloutJournal,
+    recover_rollout,
+)
 from roko_tpu.serve.scheduler import ContinuousBatcher
 from roko_tpu.serve.server import drain, make_server, serve_forever
 from roko_tpu.serve.session import PolishSession
@@ -44,13 +60,22 @@ __all__ = [
     "MicroBatcher",
     "PolishClient",
     "PolishSession",
+    "RegistryError",
+    "RegistryMismatch",
+    "RolloutController",
+    "RolloutJournal",
     "ServeMetrics",
     "ServerBusy",
     "ServiceUnavailable",
     "WorkerHandle",
+    "WorkerLaunchSpec",
     "drain",
+    "list_models",
     "make_front_server",
     "make_server",
+    "recover_rollout",
+    "register_model",
+    "resolve_model",
     "run_supervisor",
     "serve_forever",
 ]

@@ -95,6 +95,38 @@ PASSTHROUGH_SERIES = (
 #: the failover trigger (a dead/killed worker mid-request lands here)
 _CONN_ERRORS = (OSError, http.client.HTTPException)
 
+#: version label of the launch spec a fleet boots with, before any
+#: rollout has installed a registry-named one (docs/SERVING.md
+#: "Model lifecycle")
+BOOT_VERSION = "boot"
+
+
+class WorkerLaunchSpec:
+    """Everything that determines WHICH program a spawned worker runs:
+    the argv builder (model path + per-version worker config, so the
+    AOT bundle rides inside), the env overlay (device pinning), and the
+    version label it serves under. Initial spawn, crash restart, and
+    rollout all resolve a worker's launch through ONE spec
+    (``Fleet._spawn``), so the three paths cannot drift on which
+    bundle/params a worker gets.
+
+    ``meta`` carries operator-facing identity (bundle digest, model
+    path) for the rollout journal and logs — the spec itself is the
+    source of truth for what actually launches."""
+
+    def __init__(
+        self,
+        command: Callable[[int, str], List[str]],
+        *,
+        env: Optional[Callable[[int], Dict[str, str]]] = None,
+        version: str = BOOT_VERSION,
+        meta: Optional[Dict[str, object]] = None,
+    ):
+        self.command = command
+        self.env = env or (lambda wid: {})
+        self.version = version
+        self.meta: Dict[str, object] = dict(meta or {})
+
 
 def write_announce(path: str, port: int) -> None:
     """Atomically publish a bound address as ``{"pid", "port"}`` — the
@@ -123,6 +155,7 @@ class WorkerHandle:
 
     def __init__(self, wid: int, runtime_dir: str, cfg: FleetConfig):
         self.id = wid
+        self._cfg = cfg
         self.announce_path = os.path.join(
             runtime_dir, f"worker-{wid}.announce.json"
         )
@@ -137,12 +170,36 @@ class WorkerHandle:
         self.misses = 0         # consecutive unanswered heartbeats
         self.ready_since = 0.0
         self.stable = False     # this incarnation survived stable_after_s
+        #: model version this incarnation runs / the next spawn targets
+        #: (rollout moves target_version, _spawn follows it)
+        self.version = BOOT_VERSION
+        self.target_version = BOOT_VERSION
+        #: True while a rollout is deliberately restarting this worker —
+        #: the supervision loop leaves held workers alone so the planned
+        #: restart is not double-handled as a crash
+        self.hold = False
+        #: last Retry-After hint this worker reported in /healthz (the
+        #: PR 10 live backlog/throughput estimate); None until it
+        #: answers a probe
+        self.retry_hint: Optional[float] = None
         #: restart-storm breaker: record_failure per death, record_success
         #: once stable; OPEN = stop restarting (fleet degrades), half-open
         #: after storm_reset_s admits exactly one probe restart
         self.storm = CircuitBreaker(
             failure_threshold=max(1, cfg.storm_threshold),
             reset_s=cfg.storm_reset_s,
+        )
+
+    def reset_regime(self) -> None:
+        """Fresh restart-storm history: a version change is a new
+        regime — deaths under the old bundle must not pre-charge the
+        new bundle's breaker (nor vice versa: the rollback trigger
+        counts NEW-bundle deaths only)."""
+        self.attempt = 0
+        self.stable = False
+        self.storm = CircuitBreaker(
+            failure_threshold=max(1, self._cfg.storm_threshold),
+            reset_s=self._cfg.storm_reset_s,
         )
 
     def alive(self) -> bool:
@@ -173,8 +230,19 @@ class Fleet:
         if fc.workers < 1:
             raise ValueError("FleetConfig.workers must be >= 1 for a fleet")
         self.fleet_cfg = fc
-        self._command = worker_command
-        self._env = worker_env or (lambda wid: {})
+        #: launch specs by version label; every spawn resolves through
+        #: one of these (docs/SERVING.md "Model lifecycle"). The
+        #: constructor's command/env pair becomes the BOOT spec;
+        #: rollouts install more via add_launch_spec.
+        self._specs: Dict[str, WorkerLaunchSpec] = {
+            BOOT_VERSION: WorkerLaunchSpec(
+                worker_command, env=worker_env, version=BOOT_VERSION
+            )
+        }
+        self.active_version = BOOT_VERSION
+        #: the live RolloutController when a rollout is running or has
+        #: run (supervisor wires it; metrics render its state)
+        self.rollout = None
         self._log = log
         self._clock = clock
         self.runtime_dir = (
@@ -207,6 +275,61 @@ class Fleet:
         self._drain_done = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    # -- launch specs -------------------------------------------------------
+
+    def _spec_live(self, version: str) -> bool:
+        # caller holds self._lock
+        return version in self._specs and any(
+            w.version == version or w.target_version == version
+            for w in self.workers
+        )
+
+    def spec_installable(self, version: str) -> bool:
+        """True when :meth:`add_launch_spec` would accept ``version`` —
+        the rollout starter checks this BEFORE building a spec, because
+        building one writes the per-version worker config to disk and a
+        refused swap must not have already changed what a live worker's
+        next crash-restart would run."""
+        with self._lock:
+            return not self._spec_live(version)
+
+    def add_launch_spec(self, spec: WorkerLaunchSpec) -> None:
+        """Register a version's launch spec so rollout / restart can
+        spawn workers onto it. Replacing the spec of a version workers
+        currently run is refused — that is exactly the silent-drift this
+        indirection exists to prevent (register a new version instead)."""
+        with self._lock:
+            if self._spec_live(spec.version):
+                raise ValueError(
+                    f"launch spec {spec.version!r} is live on the fleet; "
+                    "refusing to swap it underneath running workers"
+                )
+            self._specs[spec.version] = spec
+
+    def install_boot_spec(self, spec: WorkerLaunchSpec) -> None:
+        """Replace the constructor's placeholder boot spec BEFORE
+        ``start()`` — the supervisor can only build the real one (whose
+        per-version worker config lives in the runtime dir) after the
+        runtime dir exists, and rollout recovery may boot a different
+        version than the CLI named."""
+        with self._lock:
+            if any(w.alive() for w in self.workers):
+                raise RuntimeError(
+                    "install_boot_spec must run before the fleet starts"
+                )
+            self._specs = {spec.version: spec}
+            self.active_version = spec.version
+            for w in self.workers:
+                w.version = w.target_version = spec.version
+
+    def launch_spec(self, version: Optional[str] = None) -> WorkerLaunchSpec:
+        with self._lock:
+            return self._specs[version or self.active_version]
+
+    def has_spec(self, version: str) -> bool:
+        with self._lock:
+            return version in self._specs
+
     # -- counters -----------------------------------------------------------
 
     def inc(self, name: str, by: int = 1) -> None:
@@ -235,19 +358,27 @@ class Fleet:
             os.unlink(w.announce_path)
         except OSError:
             pass
+        # THE one resolution point for what a worker runs: initial
+        # spawn, crash restart, and rollout all land here, and all read
+        # the worker's target version's launch spec — argv (model path +
+        # per-version config carrying the bundle) and env overlay both
+        spec = self.launch_spec(w.target_version)
+        if spec.version != w.version:
+            w.reset_regime()  # storm history belongs to the old bundle
+        w.version = spec.version
         env = dict(os.environ)
-        env.update(self._env(w.id))
+        env.update(spec.env(w.id))
         env["ROKO_WORKER_ID"] = str(w.id)
         # append: across restarts one log per worker slot keeps the
         # whole crash history in a single CI-dumpable file
         logf = open(w.log_path, "ab", buffering=0)
         try:
             logf.write(
-                f"\n--- spawn worker {w.id} (restart {w.restarts}) ---\n"
-                .encode()
+                f"\n--- spawn worker {w.id} (restart {w.restarts}, "
+                f"version {spec.version}) ---\n".encode()
             )
             w.proc = subprocess.Popen(
-                self._command(w.id, w.announce_path),
+                spec.command(w.id, w.announce_path),
                 stdout=logf,
                 stderr=subprocess.STDOUT,
                 env=env,
@@ -259,6 +390,43 @@ class Fleet:
         w.port = None
         w.misses = 0
         w.stable = False
+        # a dead incarnation's backlog estimate must not inflate
+        # front-end 503s (live_retry_after_s takes the fleet MAX)
+        w.retry_hint = None
+
+    def roll_worker(self, w: WorkerHandle, version: str) -> None:
+        """Deliberate restart of ONE worker onto ``version`` (the
+        rollout path, docs/SERVING.md "Model lifecycle"): the worker
+        leaves rotation (DRAINING), gets SIGTERM — it finishes its own
+        in-flight requests under the drain deadline — then respawns
+        immediately from the new version's launch spec. ``hold`` keeps
+        the supervision loop from double-handling the planned death as
+        a crash; it resumes tracking the fresh incarnation (warming →
+        ready) the moment the spawn lands."""
+        if not self.has_spec(version):
+            raise ValueError(f"no launch spec for version {version!r}")
+        with self._lock:
+            if self._draining:
+                # a stopping fleet must not grow fresh workers that
+                # would outlive the drain as orphans
+                raise RuntimeError("fleet is draining; not rolling workers")
+            w.hold = True
+            if w.state == READY:
+                w.state = DRAINING  # routing excludes it from here on
+        try:
+            grace = (
+                self.cfg.resilience.drain_deadline_s
+                + self.fleet_cfg.term_grace_s
+            )
+            self._terminate(w, grace)
+            with self._lock:
+                w.target_version = version
+            w.restarts += 1
+            self.inc("restarts")
+            self._spawn(w, self._clock())
+        finally:
+            with self._lock:
+                w.hold = False
 
     def stop(
         self, *, rolling: bool = True, cleanup: bool = True
@@ -338,6 +506,10 @@ class Fleet:
 
     def _check(self, w: WorkerHandle, now: float) -> None:
         cfg = self.fleet_cfg
+        if w.hold:
+            # a rollout is deliberately restarting this worker; its
+            # death is planned, not a crash to supervise
+            return
         if w.state in (FAILED, DEAD):
             if w.state == DEAD and now < w.restart_at:
                 return
@@ -390,6 +562,12 @@ class Fleet:
                 )
             return
         w.misses = 0
+        hint = body.get("retry_after_s")
+        if isinstance(hint, (int, float)) and hint > 0:
+            # the worker's live backlog/throughput Retry-After estimate
+            # (PR 10) rides in healthz; cache it so front-end 503s can
+            # promise a real wait instead of the static config guess
+            w.retry_hint = float(hint)
         status = body.get("status", "")
         if code == 200:
             if w.state != READY:
@@ -457,6 +635,22 @@ class Fleet:
     def ready_count(self) -> int:
         return sum(1 for w in self.workers if w.state == READY)
 
+    def live_retry_after_s(self) -> float:
+        """Retry-After for front-end 503s (draining, at capacity, no
+        worker available): the LARGEST hint any live worker reported in
+        its last answered /healthz — each worker computes its own from
+        live backlog over observed throughput (docs/SERVING.md
+        "Continuous batching") — falling back to the static
+        ``serve.retry_after_s`` only when no worker has answered (none
+        bound yet, or all dead)."""
+        with self._lock:
+            hints = [
+                w.retry_hint
+                for w in self.workers
+                if w.retry_hint is not None and w.alive()
+            ]
+        return max(hints) if hints else self.cfg.serve.retry_after_s
+
     def pick(
         self, exclude: Sequence[int] = ()
     ) -> Optional[Tuple[WorkerHandle, int]]:
@@ -490,7 +684,9 @@ class Fleet:
         ``(status, reply_body, extra_headers)``."""
         cfg = self.fleet_cfg
         tried: List[int] = []
-        retry_after = self.cfg.serve.retry_after_s
+        # resolved lazily: the live hint sweeps every worker's waitpid
+        # under the lock, which the hot 200 path must never pay
+        retry_after: Optional[float] = None
         for _ in range(max(1, cfg.failover_attempts)):
             picked = self.pick(exclude=tried)
             if picked is None:
@@ -513,6 +709,8 @@ class Fleet:
                         w.state = UNHEALTHY
                 continue
             if code == 503:
+                if retry_after is None:
+                    retry_after = self.live_retry_after_s()
                 try:
                     retry_after = max(
                         retry_after, float(hdrs.get("Retry-After", 0))
@@ -521,6 +719,8 @@ class Fleet:
                     pass
                 continue
             return code, reply, {}
+        if retry_after is None:
+            retry_after = self.live_retry_after_s()
         body_out = json.dumps({
             "error": "no worker available (fleet busy or degraded)",
             "retry_after_s": retry_after,
@@ -581,6 +781,7 @@ class Fleet:
                 "state": w.state,
                 "port": w.port,
                 "restarts": w.restarts,
+                "version": w.version,
             }
             for w in self.workers
         }
@@ -601,6 +802,7 @@ class Fleet:
             "code": code,
             "workers": states,
             "workers_up": up,
+            "version": self.active_version,
         }
 
     def render_metrics(self) -> str:
@@ -629,6 +831,19 @@ class Fleet:
             lines.append(
                 f'{p}worker_restarts_total{{worker="{w.id}"}} {w.restarts}'
             )
+        # info-style: which model version each worker runs (the mixed-
+        # fleet window during a rollout is visible from one scrape)
+        lines.append(f"# TYPE {p}model_version gauge")
+        for w in self.workers:
+            lines.append(
+                f'{p}model_version{{worker="{w.id}",'
+                f'version="{w.version}"}} 1'
+            )
+        lines.append("# TYPE roko_rollout_state gauge")
+        lines.append(
+            "roko_rollout_state "
+            f"{getattr(self.rollout, 'state_code', lambda: 0)() if self.rollout is not None else 0}"
+        )
         names = tuple(n for n, _ in PASSTHROUGH_SERIES)
         scraped: Dict[int, Dict[str, str]] = {}
         for w in self.workers:

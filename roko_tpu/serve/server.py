@@ -358,6 +358,13 @@ class _Handler(JsonRequestHandler):
                 # fleet workers carry their id so the supervisor (and a
                 # human curl) can confirm who answered after restarts
                 body["worker_id"] = self.worker_id
+            hint = getattr(self.batcher, "retry_after_s", None)
+            if isinstance(hint, (int, float)):
+                # the live Retry-After estimate (continuous mode:
+                # backlog over observed windows/sec) rides in healthz so
+                # the fleet supervisor's own 503s can promise a real
+                # wait instead of the static config guess
+                body["retry_after_s"] = round(float(hint), 3)
             code = 200
             if breaker is not None:
                 body["breaker"] = breaker.state

@@ -17,6 +17,15 @@ HTTP surface over the fleet:
   per-worker state map.
 - ``GET /metrics`` — ``roko_fleet_*`` series plus selected per-worker
   gauges re-labeled by worker id.
+- ``POST /rollout`` / ``GET /rollout`` — start / observe a
+  health-gated zero-downtime rollout onto a registered model version
+  (``serve/rollout.py``, docs/SERVING.md "Model lifecycle").
+
+Every front-end 503 (draining, at capacity, no worker available)
+carries the LARGEST live worker ``Retry-After`` hint (each worker
+estimates its own from live backlog over observed throughput and
+reports it in ``/healthz``); the static ``serve.retry_after_s`` is only
+the fallback when no worker has answered.
 
 The supervisor process NEVER initialises a jax backend: on TPU it must
 not claim the chips its workers need, so it loads no params, builds no
@@ -32,14 +41,33 @@ the way down.
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import os
 import sys
+import threading
 from http.server import ThreadingHTTPServer
-from typing import Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from roko_tpu.config import RokoConfig
+from roko_tpu.config import ModelConfig, RokoConfig
 from roko_tpu.parallel.mesh import fleet_worker_env
-from roko_tpu.serve.fleet import Fleet, write_announce
+from roko_tpu.serve.fleet import (
+    BOOT_VERSION,
+    Fleet,
+    WorkerLaunchSpec,
+    write_announce,
+)
+from roko_tpu.serve.registry import (
+    RegistryError,
+    resolve_model,
+    resolve_registry_dir,
+)
+from roko_tpu.serve.rollout import (
+    CurrentVersionFile,
+    RolloutController,
+    RolloutJournal,
+    recover_rollout,
+)
 from roko_tpu.serve.server import (
     JsonRequestHandler,
     drain,
@@ -65,20 +93,54 @@ class _FrontHandler(JsonRequestHandler):
                 self.fleet.render_metrics().encode(),
                 content_type="text/plain; version=0.0.4",
             )
+        elif self.path == "/rollout":
+            ctl = self.fleet.rollout
+            self._reply_json(
+                200, ctl.status() if ctl is not None else {"state": "idle"}
+            )
         else:
             self._reply_json(404, {"error": f"no route {self.path}"})
 
+    def _handle_rollout(self) -> None:
+        starter = getattr(self.server, "_start_rollout", None)
+        if starter is None:
+            self._reply_json(
+                501,
+                {"error": "rollout is not configured on this front end "
+                          "(run via `roko-tpu serve --workers N`)"},
+            )
+            return
+        raw = self._read_body()
+        if raw is None:
+            return  # error reply already sent
+        try:
+            payload = json.loads(raw.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("payload must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        code, body = starter(payload)
+        self._reply_json(code, body)
+
     def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path == "/rollout":
+            self._handle_rollout()
+            return
         if self.path != "/polish":
             self._reply_json(404, {"error": f"no route {self.path}"})
             return
         fleet = self.fleet
-        retry = fleet.cfg.serve.retry_after_s
         with self._track_inflight():
             # draining checked AFTER the increment (same TOCTOU rule as
             # the worker server: drain() watches the counter)
             if self.server._draining.is_set():  # type: ignore[attr-defined]
                 self.close_connection = True
+                # live hint: the max Retry-After any up worker last
+                # reported (static config value when none have
+                # answered) — computed only on the 503 paths, never the
+                # hot success path (it sweeps every worker's waitpid)
+                retry = fleet.live_retry_after_s()
                 self._reply_json(
                     503,
                     {"error": "fleet draining", "retry_after_s": retry},
@@ -92,6 +154,7 @@ class _FrontHandler(JsonRequestHandler):
                 # capacity, shed here instead of stacking relays behind
                 # workers that will 503 anyway
                 fleet.inc("rejected")
+                retry = fleet.live_retry_after_s()
                 self._reply_json(
                     503,
                     {"error": "fleet at capacity",
@@ -135,6 +198,9 @@ def make_front_server(
         handler,
     )
     server.fleet = fleet  # type: ignore[attr-defined]
+    #: POST /rollout implementation; run_supervisor wires the real one
+    #: (needs the registry + journal), bare front ends answer 501
+    server._start_rollout = None  # type: ignore[attr-defined]
     init_lifecycle(server, fleet.cfg.resilience.drain_deadline_s)
     return server
 
@@ -159,6 +225,162 @@ def worker_command(
     return build
 
 
+def worker_launch_spec(
+    version: str,
+    model_path: str,
+    cfg: RokoConfig,
+    runtime_dir: str,
+    *,
+    meta: Optional[Dict[str, Any]] = None,
+) -> WorkerLaunchSpec:
+    """THE builder for what a worker of ``version`` runs: writes the
+    per-version worker config JSON (``fleet.workers`` zeroed so a child
+    can never recurse into supervisor mode; the version's AOT bundle
+    riding in ``compile.bundle_dir``) and returns the spec initial
+    spawn, crash restart, and rollout all resolve through —
+    ``Fleet._spawn`` reads nothing else, so the three paths cannot
+    drift on which bundle/params a worker gets."""
+    fc = cfg.fleet
+    worker_cfg = dataclasses.replace(
+        cfg, fleet=dataclasses.replace(fc, workers=0)
+    )
+    os.makedirs(runtime_dir, exist_ok=True)
+    config_path = os.path.join(
+        runtime_dir, f"worker-config-{version}.json"
+    )
+    with open(config_path, "w") as f:
+        f.write(worker_cfg.to_json())
+    spec_meta: Dict[str, Any] = {
+        "model_path": model_path,
+        "bundle_dir": cfg.compile.bundle_dir,
+        "model": dataclasses.asdict(cfg.model),
+    }
+    spec_meta.update(meta or {})
+    return WorkerLaunchSpec(
+        worker_command(model_path, config_path),
+        env=lambda wid: fleet_worker_env(
+            wid, fc.workers, fc.devices_per_worker
+        ),
+        version=version,
+        meta=spec_meta,
+    )
+
+
+def _version_config(cfg: RokoConfig, side: Dict[str, Any]) -> RokoConfig:
+    """The supervisor config specialised to one version's identity: the
+    side dict (a registry entry, or a journal record's from/to block)
+    names the bundle dir and — when it carries one — the full
+    ModelConfig the bundle was compiled for, so a rollout across model
+    kinds or precision variants launches workers whose config matches
+    the bundle digest instead of refusing at warmup."""
+    out = dataclasses.replace(
+        cfg,
+        compile=dataclasses.replace(
+            cfg.compile, bundle_dir=side.get("bundle_dir")
+        ),
+    )
+    model = side.get("model") or {}
+    if model:
+        out = dataclasses.replace(
+            out,
+            model=ModelConfig(
+                **{
+                    k: tuple(v) if k == "read_mlp" else v
+                    for k, v in model.items()
+                }
+            ),
+        )
+    return out
+
+
+def make_rollout_starter(
+    fleet: Fleet,
+    journal: RolloutJournal,
+    model_path: str,
+    cfg: RokoConfig,
+    log=print,
+) -> Callable[[Dict[str, Any]], Tuple[int, Dict[str, Any]]]:
+    """The ``POST /rollout`` implementation: resolve+verify the named
+    registry version, install its launch spec, and start a
+    :class:`RolloutController` — one at a time (409 while one is
+    active). Returns ``(http_code, json_body)``."""
+    lock = threading.Lock()
+
+    def start(payload: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        name = payload.get("name")
+        if not isinstance(name, str) or not name:
+            return 400, {"error": "body must carry the model version "
+                                  '{"name": "<registered name>"}'}
+        overrides = {}
+        for key in ("bake_s", "rollback_error_pct", "rollback_p99_x",
+                    "ready_timeout_s"):
+            val = payload.get(key)
+            if val is None:
+                continue
+            if not isinstance(val, (int, float)) or val < 0:
+                return 400, {"error": f"{key} must be a non-negative "
+                                      "number"}
+            overrides[key] = float(val)
+        with lock:
+            ctl = fleet.rollout
+            if ctl is not None and ctl.active():
+                return 409, {
+                    "error": "a rollout is already in progress",
+                    "status": ctl.status(),
+                }
+            if fleet.active_version == name:
+                return 409, {
+                    "error": f"fleet is already on version {name!r}",
+                }
+            try:
+                entry = resolve_model(
+                    resolve_registry_dir(fleet.fleet_cfg.registry_dir),
+                    name,
+                )
+            except RegistryError as e:
+                return 400, {"error": str(e)}
+            # ALWAYS rebuild the spec from the freshly verified entry —
+            # a version re-registered (--force) since a failed attempt
+            # must roll out its NEW bytes, not a stale cached spec. The
+            # admission check runs FIRST: building a spec writes the
+            # per-version worker config, and a refused swap must not
+            # have already changed what a live worker's next
+            # crash-restart would run.
+            if not fleet.spec_installable(name):
+                return 409, {
+                    "error": f"launch spec {name!r} is live on the "
+                             "fleet; refusing to swap it underneath "
+                             "running workers",
+                }
+            # a bundle-only version (no params pinned) rolls out
+            # against the fleet's CURRENT incumbent checkpoint — the
+            # active spec's params, which after an earlier rollout is
+            # NOT the checkpoint the CLI was started with
+            incumbent_params = (
+                fleet.launch_spec().meta.get("model_path") or model_path
+            )
+            try:
+                fleet.add_launch_spec(
+                    worker_launch_spec(
+                        name,
+                        entry.get("params_path") or incumbent_params,
+                        _version_config(cfg, entry),
+                        fleet.runtime_dir,
+                        meta={"bundle_digest": entry["bundle_digest"]},
+                    )
+                )
+            except ValueError as e:  # raced; the backstop still holds
+                return 409, {"error": str(e)}
+            ctl = RolloutController(
+                fleet, name, journal=journal, log=log, **overrides
+            )
+            fleet.rollout = ctl
+            ctl.start()
+            return 202, ctl.status()
+
+    return start
+
+
 def rolling_drain(
     server: ThreadingHTTPServer, fleet: Fleet, log=print
 ) -> None:
@@ -181,38 +403,77 @@ def run_supervisor(
     """The ``roko-tpu serve --workers N`` entry point: spawn the fleet,
     bind the front end, serve until SIGTERM/Ctrl-C. ``announce`` (used
     by tests/automation) writes ``{"pid", "port"}`` once the front-end
-    socket is bound — the same contract workers honour."""
-    fc = cfg.fleet
-    # the worker config: fleet.workers zeroed so a worker can never
-    # recurse into supervisor mode, everything else (model geometry,
-    # serve ladder, AOT bundle, resilience knobs) shared verbatim
-    import dataclasses
+    socket is bound — the same contract workers honour.
 
+    Before anything spawns, the rollout journal in the runtime dir is
+    consulted: a supervisor killed mid-rollout restarts onto ONE
+    version — finalized forward when every worker had already rolled,
+    reverted to the journaled incumbent otherwise — loudly, never a
+    silently mixed fleet (``serve/rollout.py``)."""
+    fc = cfg.fleet
     fleet = Fleet(
         cfg,
-        worker_command=(lambda *_: []),  # bound below, needs runtime_dir
-        worker_env=lambda wid: fleet_worker_env(
-            wid, fc.workers, fc.devices_per_worker
-        ),
+        worker_command=(lambda *_: []),  # placeholder; boot spec below
         log=log,
     )
     os.makedirs(fleet.runtime_dir, exist_ok=True)
-    config_path = os.path.join(fleet.runtime_dir, "worker-config.json")
-    worker_cfg = dataclasses.replace(
-        cfg, fleet=dataclasses.replace(fc, workers=0)
+    journal = RolloutJournal(
+        os.path.join(fleet.runtime_dir, RolloutJournal.FILENAME)
     )
-    with open(config_path, "w") as f:
-        f.write(worker_cfg.to_json())
-    fleet._command = worker_command(model_path, config_path)
+    current = CurrentVersionFile(
+        os.path.join(fleet.runtime_dir, CurrentVersionFile.FILENAME)
+    )
+    boot_version, boot_model, boot_cfg = BOOT_VERSION, model_path, cfg
+    recovery = recover_rollout(journal, log)
+    if recovery is not None:
+        rec = recovery["record"]
+        side = rec["to"] if recovery["action"] == "finalize" else rec["from"]
+        boot_version = side.get("version") or BOOT_VERSION
+        boot_model = side.get("model_path") or model_path
+        boot_cfg = _version_config(cfg, side)
+        # keep the landed-version pointer consistent with the decision
+        if boot_version == BOOT_VERSION:
+            current.delete()
+        else:
+            current.write(side)
+    else:
+        # no interrupted rollout — but a COMPLETED one must survive a
+        # plain supervisor restart: re-pin the landed version instead
+        # of silently re-booting the CLI-named incumbent
+        pinned = current.load(log)
+        if pinned and (pinned.get("version") or BOOT_VERSION) != BOOT_VERSION:
+            boot_version = pinned["version"]
+            boot_model = pinned.get("model_path") or model_path
+            boot_cfg = _version_config(cfg, pinned)
+            log(
+                f"ROKO_ROLLOUT event=version_pinned version={boot_version}"
+                f" bundle_digest={str(pinned.get('bundle_digest', '?'))[:12]}"
+                " — restart re-pins the landed rollout version"
+            )
+    fleet.install_boot_spec(
+        worker_launch_spec(
+            boot_version, boot_model, boot_cfg, fleet.runtime_dir
+        )
+    )
 
     server = make_front_server(fleet)
+    # the starter's fallback identity is what the fleet actually BOOTED
+    # (a recovered/pinned version, not necessarily the CLI args)
+    server._start_rollout = make_rollout_starter(  # type: ignore[attr-defined]
+        fleet, journal, boot_model, boot_cfg, log=log
+    )
     if announce:
         write_announce(announce, server.server_address[1])
     log(
         f"roko fleet: supervising {fc.workers} worker(s) "
-        f"(runtime dir {fleet.runtime_dir}); front end binding"
+        f"(runtime dir {fleet.runtime_dir}, version {boot_version}); "
+        "front end binding"
     )
     fleet.start()
+    if recovery is not None:
+        # every worker just spawned from the one recovered spec — the
+        # fleet is uniform again and the journal has done its job
+        journal.delete()
     try:
         serve_forever(
             server,

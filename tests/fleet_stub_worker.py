@@ -17,9 +17,17 @@ Failure modes are injected through the environment:
   pin requests in flight across a drain)
 - ``STUB_UNHEALTHY=1``       — healthz 503 "unhealthy" (breaker-open
   stand-in: alive, out of rotation)
+- ``STUB_VERSION=NAME``      — model version label carried in healthz
+  and polish replies (rollout tests tell versions apart by it)
+- ``STUB_RETRY_AFTER_S=T``   — report this live Retry-After hint in
+  healthz (the PR 10 dynamic-backpressure stand-in); absent = no hint
+- ``STUB_ERROR_EVERY=N``     — every Nth polish replies 500 and counts
+  in errors_total (the rollout canary-gate trigger)
+- ``STUB_P99_S=T``           — report this request p99 in /metrics
 
 Replies carry this process's pid so tests can see WHICH incarnation
-answered across restarts.
+answered across restarts; /metrics renders live requests/errors
+counters beside the static passthrough series.
 """
 
 from __future__ import annotations
@@ -44,6 +52,11 @@ CRASH_AFTER = int(os.environ.get("STUB_CRASH_AFTER", "0"))
 HANG_AFTER_S = float(os.environ.get("STUB_HANG_AFTER_S", "0"))
 POLISH_DELAY_S = float(os.environ.get("STUB_POLISH_DELAY_S", "0"))
 UNHEALTHY = os.environ.get("STUB_UNHEALTHY") == "1"
+VERSION = os.environ.get("STUB_VERSION", "")
+RETRY_AFTER_S = os.environ.get("STUB_RETRY_AFTER_S", "")
+ERROR_EVERY = int(os.environ.get("STUB_ERROR_EVERY", "0"))
+P99_S = os.environ.get("STUB_P99_S", "")
+ERRORS = 0
 
 METRICS = """\
 # TYPE roko_serve_breaker_state gauge
@@ -78,28 +91,46 @@ class Handler(BaseHTTPRequestHandler):
     def _reply_json(self, code, obj):
         self._reply(code, json.dumps(obj).encode())
 
+    def _health_body(self, status):
+        body = {"status": status, "worker_pid": os.getpid()}
+        if VERSION:
+            body["version"] = VERSION
+        if RETRY_AFTER_S:
+            body["retry_after_s"] = float(RETRY_AFTER_S)
+        return body
+
     def do_GET(self):  # noqa: N802
         self._maybe_hang()
         if self.path == "/healthz":
             if DRAINING.is_set():
-                self._reply_json(503, {"status": "draining"})
+                self._reply_json(503, self._health_body("draining"))
             elif time.monotonic() - START < WARM_S:
-                self._reply_json(503, {"status": "warming"})
+                self._reply_json(503, self._health_body("warming"))
             elif UNHEALTHY:
-                self._reply_json(
-                    503, {"status": "unhealthy", "breaker": "open"}
-                )
+                body = self._health_body("unhealthy")
+                body["breaker"] = "open"
+                self._reply_json(503, body)
             else:
-                self._reply_json(
-                    200, {"status": "ok", "worker_pid": os.getpid()}
-                )
+                self._reply_json(200, self._health_body("ok"))
         elif self.path == "/metrics":
-            self._reply(200, METRICS.encode(), ctype="text/plain")
+            text = METRICS + (
+                "# TYPE roko_serve_requests_total counter\n"
+                f"roko_serve_requests_total {POLISHED}\n"
+                "# TYPE roko_serve_errors_total counter\n"
+                f"roko_serve_errors_total {ERRORS}\n"
+            )
+            if P99_S:
+                text += (
+                    "# TYPE roko_serve_request_latency_seconds summary\n"
+                    'roko_serve_request_latency_seconds{quantile="0.99"} '
+                    f"{float(P99_S)}\n"
+                )
+            self._reply(200, text.encode(), ctype="text/plain")
         else:
             self._reply_json(404, {"error": "no route"})
 
     def do_POST(self):  # noqa: N802
-        global POLISHED
+        global POLISHED, ERRORS
         self._maybe_hang()
         length = int(self.headers.get("Content-Length", "0"))
         raw = self.rfile.read(length)
@@ -125,12 +156,19 @@ class Handler(BaseHTTPRequestHandler):
                 n = int(json.loads(raw or b"{}").get("n", 0))
             except ValueError:
                 n = 0
-            self._reply_json(
-                200,
-                {"contig": "stub", "polished": f"STUB-{os.getpid()}",
-                 "windows": n},
-            )
             POLISHED += 1
+            if ERROR_EVERY and POLISHED % ERROR_EVERY == 0:
+                # injected canary failure: a 500 counted in errors_total
+                # (what the rollout gate watches), relayed verbatim by
+                # the front end
+                ERRORS += 1
+                self._reply_json(500, {"error": "injected canary failure"})
+                return
+            reply = {"contig": "stub", "polished": f"STUB-{os.getpid()}",
+                     "windows": n}
+            if VERSION:
+                reply["version"] = VERSION
+            self._reply_json(200, reply)
             if CRASH_AFTER and POLISHED >= CRASH_AFTER:
                 time.sleep(0.05)  # let the reply bytes leave the socket
                 os._exit(1)

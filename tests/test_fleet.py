@@ -523,6 +523,51 @@ def test_front_admission_control(tmp_path):
         fleet.stop(rolling=False)
 
 
+def test_metrics_aggregation_survives_worker_death_mid_scrape(tmp_path):
+    """Supervisor /metrics with a worker dying around the scrape: the
+    passthrough simply omits the unanswering worker — fleet-level
+    series and the surviving worker's labeled rows still render, no
+    exception ever escapes to the scraper."""
+    fleet = make_fleet(tmp_path, workers=2)
+    fleet.start()
+    try:
+        wait_until(lambda: fleet.ready_count() == 2, msg="2 workers ready")
+        # (a) worker killed between heartbeat and scrape: proc dead,
+        # state/port still READY-looking to render_metrics
+        w0 = fleet.workers[0]
+        w0.proc.kill()
+        w0.proc.wait(10.0)
+        text = fleet.render_metrics()
+        assert "roko_fleet_workers 2" in text
+        assert 'roko_serve_breaker_state{worker="1"} 0' in text
+        assert 'roko_serve_breaker_state{worker="0"}' not in text
+        # (b) worker alive but its socket gone (stale port): the scrape
+        # gets connection-refused and the worker is omitted, not fatal
+        import socket
+
+        w1 = fleet.workers[1]
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            stale = s.getsockname()[1]
+        real_port = w1.port
+        w1.port = stale
+        try:
+            text = fleet.render_metrics()
+            assert "roko_fleet_workers_up" in text
+            assert 'roko_serve_breaker_state{worker="1"}' not in text
+        finally:
+            w1.port = real_port
+        # (c) every worker unanswering: fleet series alone, no
+        # passthrough TYPE headers for absent series
+        w1.proc.kill()
+        w1.proc.wait(10.0)
+        text = fleet.render_metrics()
+        assert "roko_fleet_restarts_total" in text
+        assert "roko_serve_breaker_state" not in text
+    finally:
+        fleet.stop(rolling=False)
+
+
 # -- real-worker acceptance (slow) -------------------------------------------
 
 TINY = dict(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)

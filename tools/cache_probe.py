@@ -5,6 +5,7 @@ its digest + rungs + the backend/jax it was built for.
     python tools/cache_probe.py                     # the resolved cache
     python tools/cache_probe.py --cache DIR         # a specific cache
     python tools/cache_probe.py --bundle DIR [...]  # bundle digests too
+    python tools/cache_probe.py --registry [DIR]    # model registry too
 
 Reads only — safe to run next to a live service. Exit 0 always (an
 absent cache is a fact, not a failure). ``ROKO_COMPILE_CACHE`` is
@@ -27,6 +28,12 @@ def main() -> int:
     ap.add_argument(
         "--bundle", action="append", default=[],
         help="AOT bundle dir(s) to summarise (repeatable)",
+    )
+    ap.add_argument(
+        "--registry", nargs="?", const="", default=None, metavar="DIR",
+        help="also list the model registry (named version -> bundle "
+        "digest + params manifest digest; default dir when no DIR "
+        "given — docs/SERVING.md 'Model lifecycle')",
     )
     args = ap.parse_args()
 
@@ -68,6 +75,24 @@ def main() -> int:
             f"rungs={man.get('rungs')} backend={ident.get('backend')}/"
             f"{ident.get('device_kind')} jax={ident.get('jax_version')}"
         )
+
+    if args.registry is not None:
+        from roko_tpu.serve.registry import list_models, resolve_registry_dir
+
+        reg_dir = resolve_registry_dir(args.registry or None)
+        entries = list_models(reg_dir)
+        print(f"registry: {reg_dir} versions={len(entries)}")
+        for e in entries:
+            model = e.get("model") or {}
+            pdigest = (e.get("params_manifest") or {}).get("tree_digest", "")
+            print(
+                f"model: {e['name']} kind={model.get('kind', '?')} "
+                f"compute_dtype={model.get('compute_dtype', '?')} "
+                f"quantize={model.get('quantize') or 'none'} "
+                f"bundle={e.get('bundle_digest', '?')[:12]} "
+                f"params={pdigest[:12] or 'incumbent'} "
+                f"rungs={e.get('rungs')} dir={e.get('bundle_dir')}"
+            )
     return 0
 
 
