@@ -1110,6 +1110,22 @@ def _measure(args) -> Dict[str, Any]:
         except Exception as e:  # report, never swallow
             detail["input"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("input", detail["input"])
+    mesh_devices = getattr(args, "mesh_devices", None)
+    if mesh_devices is None:
+        # default follows the e2e scale decision (as coldstart):
+        # contract-mode runs skip it, the driver's plain run measures
+        # the one-session-every-chip scaling rows (ROADMAP item 2;
+        # always CPU-simulated devices — real-TPU rows are item 6 debt)
+        mesh_devices = DEFAULT_MESH_DEVICES if e2e_draft else ()
+    if mesh_devices:
+        _stamp(f"mesh suite (simulated devices {tuple(mesh_devices)})")
+        try:
+            detail["mesh"] = run_mesh_suite(
+                mesh_devices, iterations=bench_iters
+            )
+        except Exception as e:  # report, never swallow
+            detail["mesh"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("mesh", detail["mesh"])
     # an EXPLICIT --serve-mix also threads the mixed workload through
     # the fleet suite (per-size-class latency + per-worker padding
     # efficiency for both batching modes); the default driver run keeps
@@ -1237,6 +1253,14 @@ def compare_to_previous(
             pairs[f"precision.{kind}.{col}"] = (
                 (row or {}).get(col), prow.get(col),
             )
+    # mesh rows (ROADMAP item 2): per-device-count windows/sec on the
+    # same fixed global work, same noise discipline
+    for n, row in ((cur_d.get("mesh") or {}).get("rows") or {}).items():
+        prow = ((prev_d.get("mesh") or {}).get("rows") or {}).get(n) or {}
+        pairs[f"mesh.{n}.windows_per_sec"] = (
+            (row or {}).get("windows_per_sec"),
+            prow.get("windows_per_sec"),
+        )
     metrics: Dict[str, Any] = {}
     for name, (cur, old) in pairs.items():
         if (
@@ -1373,6 +1397,11 @@ def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
             cmd += [
                 "--fleet-workers",
                 ",".join(str(n) for n in args.fleet_workers) or "0",
+            ]
+        if getattr(args, "mesh_devices", None) is not None:
+            cmd += [
+                "--mesh-devices",
+                ",".join(str(n) for n in args.mesh_devices) or "0",
             ]
         if getattr(args, "bench_iterations", None) is not None:
             cmd += ["--bench-iterations", str(args.bench_iterations)]
@@ -1633,18 +1662,29 @@ def run_pipeline_suite(
         out["windows"] = n
         out["staged"] = staged
 
+        from roko_tpu.serve.metrics import ServeMetrics
+
         timer = StageTimer()
+        stream_metrics = ServeMetrics()
         t0 = time.perf_counter()
         stream_polished = run_streaming_polish(
             fasta, bam, params, cfg, seed=0, workers=workers,
             batch_size=BATCH, log=quiet, timer=timer,
+            metrics=stream_metrics,
         )
         wall = time.perf_counter() - t0
         spans = {k: round(v, 3) for k, v in sorted(timer.totals.items())}
+        fill = stream_metrics.fill_ratio()
         streaming = {
             "wall_s": round(wall, 3),
             "stage_spans_s": spans,
             "span_sum_s": round(sum(timer.totals.values()), 3),
+            # the SAME ServeMetrics series serve exports (one batching
+            # plane): real windows / padded rows the ContinuousBatcher
+            # dispatched for this whole polish. The old deadline
+            # batcher padded each flushed partial up to a rung; dense
+            # packing makes this the number to watch.
+            "padding_efficiency": None if fill is None else round(fill, 4),
         }
         out["streaming"] = streaming
         out["overlap_efficiency"] = round(staged["serial_sum_s"] / wall, 3)
@@ -1839,6 +1879,171 @@ def run_coldstart_suite(
             results[f"speedup_{key}"] = round(
                 results["cold"]["ttfp_s"] / denom, 2
             )
+    return results
+
+
+#: mesh suite: simulated device counts (--mesh-devices), fixed-work
+#: timed iterations (--bench-iterations overrides), and the fixed
+#: GLOBAL batch every count shards (divisible by every default count)
+DEFAULT_MESH_DEVICES = (1, 2, 4)
+MESH_SUITE_ITERS = 8
+MESH_SUITE_GLOBAL_BATCH = 128
+
+
+def _mesh_child(spec_path: str) -> None:
+    """Child half of :func:`run_mesh_suite` — runs in its OWN process
+    because the simulated device count
+    (``--xla_force_host_platform_device_count``, set by the parent via
+    the env) is fixed at backend init. Builds ONE mesh-sharded
+    PolishSession over every visible device (dp = all), times the fixed
+    global batch, and reports windows/sec plus a sha256 of the
+    predictions so the parent can assert sharded == single-device
+    byte-identity."""
+    import dataclasses
+    import hashlib
+
+    with open(spec_path) as f:
+        spec = json.load(f)
+
+    import jax
+
+    from roko_tpu import constants as C
+    from roko_tpu.config import MeshConfig, RokoConfig
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.serve.session import PolishSession
+
+    cfg = (
+        RokoConfig.from_json(json.dumps(spec["config"]))
+        if spec.get("config")
+        else RokoConfig()
+    )
+    n_dev = len(jax.devices())
+    cfg = dataclasses.replace(cfg, mesh=MeshConfig(dp=n_dev, tp=1, sp=1))
+    gb = int(spec["global_batch"])
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+    session = PolishSession(params, cfg, ladder=(gb,))
+    session.warmup()
+    rows = cfg.model.window_rows
+    cols = cfg.model.window_cols
+    rng = np.random.default_rng(0)  # same seed in every child: same work
+    x = rng.integers(0, C.FEATURE_VOCAB, (gb, rows, cols)).astype(np.uint8)
+    preds = session.predict(x)  # proving dispatch outside the clock
+    iters = int(spec["iterations"])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        session.predict(x)
+    wall = time.perf_counter() - t0
+    out = {
+        "devices": n_dev,
+        "mesh_dp": session.dp,
+        "global_batch": gb,
+        "per_device_batch": gb // session.dp,
+        "iterations": iters,
+        "wall_s": round(wall, 3),
+        "windows_per_sec": round(iters * gb / max(wall, 1e-9), 1),
+        # identical across device counts == the mesh-sharded predict is
+        # byte-identical to the 1-device predict on the same
+        # windows/params (ISSUE acceptance)
+        "preds_sha256": hashlib.sha256(
+            np.ascontiguousarray(preds).tobytes()
+        ).hexdigest(),
+    }
+    tmp = spec["out"] + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(out, f)
+    os.replace(tmp, spec["out"])
+
+
+def run_mesh_suite(
+    device_counts=DEFAULT_MESH_DEVICES,
+    iterations: Optional[int] = None,
+    global_batch: int = MESH_SUITE_GLOBAL_BATCH,
+    child_budget_s: float = 900.0,
+    config_json: Optional[str] = None,
+) -> Dict[str, Any]:
+    """ONE session, every chip (ROADMAP item 2): windows/sec for the
+    SAME fixed global work sharded over 1/2/4 SIMULATED devices
+    (``--xla_force_host_platform_device_count``; each count gets a fresh
+    child process because the count is fixed at backend init, always on
+    the CPU backend — the real-TPU row is ROADMAP item 6 debt).
+
+    ``scaling_efficiency`` here is windows/sec at N devices over
+    windows/sec at the SMALLEST requested count (1 by default; recorded
+    as ``efficiency_vs_devices`` so a 1-less run cannot be misread):
+    fake devices add NO silicon, so the ideal is 1.0 and the number
+    reads as 1 - sharding overhead (the ISSUE acceptance bar is >= 0.7
+    vs the 1-device row). On real chips the same rows read against N x
+    the compute. ``byte_identical`` asserts every count produced the
+    same predictions on the same windows/params."""
+    import sys
+    import tempfile
+
+    from roko_tpu.parallel.mesh import fleet_worker_env
+
+    counts = tuple(sorted(set(int(c) for c in device_counts)))
+    bad = [c for c in counts if c < 1 or global_batch % c]
+    if bad:
+        raise ValueError(
+            f"mesh suite device counts {bad} must be >= 1 and divide "
+            f"the fixed global batch {global_batch}"
+        )
+    iters = iterations or MESH_SUITE_ITERS
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    results: Dict[str, Any] = {
+        "device_counts": list(counts),
+        "global_batch": global_batch,
+        "iterations": iters,
+        "backend": "cpu (simulated devices)",
+        "rows": {},
+    }
+    with tempfile.TemporaryDirectory() as td:
+        for n in counts:
+            spec = {
+                "global_batch": global_batch,
+                "iterations": iters,
+                "out": os.path.join(td, f"mesh{n}.json"),
+            }
+            if config_json:
+                spec["config"] = json.loads(config_json)
+            spec_path = os.path.join(td, f"mesh{n}.spec.json")
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            # the canonical fake-device overlay (strips any inherited
+            # forced count before pinning this child's)
+            env.update(fleet_worker_env(0, 1, n, backend="cpu"))
+            env["ROKO_COMPILE_CACHE"] = "off"
+            cmd = [
+                sys.executable,
+                "-c",
+                "import sys; from roko_tpu.benchmark import _mesh_child; "
+                "_mesh_child(sys.argv[1])",
+                spec_path,
+            ]
+            rc, out = _spawn_logged(cmd, child_budget_s, cwd=repo_root, env=env)
+            if rc != 0:
+                raise RuntimeError(
+                    f"mesh suite child ({n} device(s)) "
+                    f"{'timed out' if rc is None else f'rc={rc}'}; log "
+                    f"tail:\n{out[-800:]}"
+                )
+            with open(spec["out"]) as f:
+                results["rows"][str(n)] = json.load(f)
+    digests = {r["preds_sha256"] for r in results["rows"].values()}
+    results["byte_identical"] = len(digests) == 1
+    # efficiency denominates against the smallest requested count —
+    # record WHICH, so a `--mesh-devices 2,4` run (no 1-device row)
+    # cannot be misread against the vs-1-device >= 0.7 acceptance bar
+    results["efficiency_vs_devices"] = counts[0]
+    base = results["rows"].get(str(counts[0]), {}).get("windows_per_sec")
+    if base:
+        results["scaling_efficiency"] = {
+            str(n): round(
+                results["rows"][str(n)]["windows_per_sec"] / base, 3
+            )
+            for n in counts[1:]
+        }
     return results
 
 
@@ -2606,6 +2811,17 @@ def main(argv=None) -> None:
         help="input suite fixed work: sim-corpus rows streamed through "
         "the datapipe index layer vs the legacy streaming reader "
         "(default 1536 when the e2e suite runs; 0 disables)",
+    )
+    ap.add_argument(
+        "--mesh-devices",
+        type=_coldstart_ladder_type,
+        default=None,
+        help="mesh suite: simulated device counts to shard the fixed "
+        "global predict batch over (fresh CPU child process per count "
+        "via --xla_force_host_platform_device_count), reporting "
+        "windows/sec, scaling efficiency vs 1 device, and sharded-vs-"
+        "single-device byte-identity; e.g. 1,2,4 (the default when the "
+        "e2e suite runs); 0 disables",
     )
     ap.add_argument(
         "--compare",

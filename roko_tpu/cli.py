@@ -464,6 +464,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--bench-iterations", str(args.bench_iterations)]
     if args.input_rows is not None:
         argv += ["--input-rows", str(args.input_rows)]
+    if args.mesh_devices is not None:
+        argv += ["--mesh-devices", args.mesh_devices]
     if args.fleet_workers is not None:
         argv += ["--fleet-workers", args.fleet_workers]
     if args.compare is not None:
@@ -557,6 +559,25 @@ def cmd_polish(args: argparse.Namespace) -> int:
     return 0
 
 
+def _workers_type(text: str):
+    """argparse type for --workers: an integer count, or ``auto`` =
+    visible devices / devices-per-worker (resolved by the supervisor
+    without initialising jax; -1 is the config sentinel)."""
+    if text.strip().lower() == "auto":
+        return -1
+    try:
+        n = int(text)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer worker count or 'auto', got {text!r}"
+        ) from None
+    if n < 0:
+        raise argparse.ArgumentTypeError(
+            "worker count must be >= 0 (use 'auto' for device-derived)"
+        )
+    return n
+
+
 def _ladder_type(text: str):
     """argparse type for --ladder: a clean usage error on a malformed
     list, not a raw int() traceback from deep inside config layering."""
@@ -589,21 +610,42 @@ def cmd_compile(args: argparse.Namespace) -> int:
     import tempfile
 
     from roko_tpu.compile import BUNDLE_MANIFEST, export_bundle
+    from roko_tpu.config import resolve_ladder
+    from roko_tpu.parallel.mesh import AXIS_DP, make_mesh
 
     cfg = _build_config(args)
-    rungs = set(args.ladder or cfg.serve.ladder)
-    if args.b:
-        rungs.add(args.b)  # batch-CLI runs dispatch at --b too
-    manifest = export_bundle(args.out, cfg, ladder=sorted(rungs))
+    # the ladder denominates against THIS mesh (auto default = per-device
+    # base rungs x dp) — resolved here so --b joins the same global rungs
+    # a session on this mesh will ask for
+    try:
+        mesh = make_mesh(cfg.mesh)
+        rungs = set(
+            args.ladder or resolve_ladder(cfg.serve, mesh.shape[AXIS_DP])
+        )
+        if args.b:
+            rungs.add(args.b)  # batch-CLI runs dispatch at --b too
+        manifest = export_bundle(args.out, cfg, mesh=mesh, ladder=sorted(rungs))
+    except ValueError as e:
+        # a bad ladder/mesh combination is an operator input error: the
+        # actionable message (naming the dp axis and the nearest valid
+        # rungs), not a traceback
+        print(f"compile: {e}", file=sys.stderr)
+        return 1
     # precision identity straight from the DIGESTED manifest (not the
     # pre-resolution config), so the operator-visible line names exactly
     # what a mismatched load would refuse on
     ident_model = manifest["identity"]["model"]
+    ident_mesh = manifest["identity"]["mesh"]
     print(
         f"compile: wrote bundle {args.out} "
         f"(kind {cfg.model.kind}, "
         f"compute_dtype={ident_model['compute_dtype']}, "
         f"quantize={ident_model['quantize'] or 'none'}, "
+        # the mesh is identity: a bundle built for this shape refuses to
+        # load into a session on any other (docs/SERVING.md
+        # "Mesh-sharded sessions")
+        f"mesh=dp{ident_mesh.get('dp')}xtp{ident_mesh.get('tp')}"
+        f"xsp{ident_mesh.get('sp')} ({mesh.devices.size} device(s)), "
         f"rungs {manifest['rungs']}, "
         f"digest {manifest['digest'][:12]})"
     )
@@ -686,13 +728,26 @@ def cmd_serve(args: argparse.Namespace) -> int:
     back — and runs the failover-routing front end over them. The
     supervisor never touches jax devices itself (on TPU it must not
     claim the chips its workers need)."""
+    import dataclasses
     import threading
     import time
 
     cfg = _build_config(args)
-    if cfg.fleet.workers > 0 and args.worker_id is None:
+    if cfg.fleet.workers != 0 and args.worker_id is None:
+        # --workers auto (-1) resolves against the VISIBLE devices and
+        # an explicit worker count x mesh size exceeding them refuses —
+        # both computed WITHOUT initialising jax (the supervisor must
+        # never claim its workers' chips)
+        from roko_tpu.parallel.mesh import resolve_fleet_topology
         from roko_tpu.serve.supervisor import run_supervisor
 
+        try:
+            cfg = dataclasses.replace(
+                cfg, fleet=resolve_fleet_topology(cfg.fleet)
+            )
+        except ValueError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 1
         return run_supervisor(args.model, cfg, announce=args.announce)
 
     from roko_tpu.compile import enable_persistent_cache
@@ -702,7 +757,15 @@ def cmd_serve(args: argparse.Namespace) -> int:
     if cache_dir:
         print(f"serve: persistent compile cache at {cache_dir}")
     params = _load_model_params(args.model, cfg)
-    session = PolishSession(params, cfg)
+    try:
+        session = PolishSession(params, cfg)
+    except ValueError as e:
+        # a ladder that cannot shard over the mesh is an operator input
+        # error: surface the actionable message (naming the dp axis and
+        # the nearest valid rungs) as a clean nonzero exit, never a
+        # traceback
+        print(f"serve: {e}", file=sys.stderr)
+        return 1
     server = make_server(
         session, cfg.serve, warming=True, worker_id=args.worker_id
     )
@@ -715,7 +778,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
 
         write_announce(args.announce, server.server_address[1])
     print(
-        f"serve: warming predict ladder {session.ladder} "
+        f"serve: mesh dp={session.dp} over {session.n_devices} "
+        f"device(s); warming predict ladder {session.ladder} "
+        f"= {session.dp} x per-device "
+        f"{tuple(r // session.dp for r in session.ladder)} "
         "(healthz=warming; /polish sheds until ready) ..."
     )
     warm_error: list = []
@@ -1056,8 +1122,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("out", help="bundle output directory")
     p.add_argument(
         "--ladder", type=_ladder_type, default=None,
-        help="comma-separated batch sizes to pre-compile (default: the "
-        "serve ladder 32,128,512; each must divide by the dp mesh axis)",
+        help="comma-separated GLOBAL batch sizes to pre-compile "
+        "(default: the serve ladder — auto = per-device base 32,128,512 "
+        "scaled by the dp mesh axis; each explicit rung must divide by "
+        "dp)",
     )
     p.add_argument(
         "--b", type=int, default=None,
@@ -1146,6 +1214,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="input suite fixed work: sim-corpus rows streamed through "
         "the datapipe index layer vs the legacy streaming reader "
         "(default 1536 when the e2e suite runs; 0 disables)",
+    )
+    p.add_argument(
+        "--mesh-devices", default=None,
+        help="mesh suite: simulated device counts for the one-session-"
+        "every-chip scaling rows (windows/sec + scaling efficiency + "
+        "sharded-vs-single-device byte-identity), e.g. 1,2,4 (the "
+        "default when the e2e suite runs); 0 disables",
     )
     p.add_argument(
         "--compare", default=None, metavar="BENCH_JSON",
@@ -1237,8 +1312,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--port", type=int, default=None, help="bind port (default 8000; 0 = ephemeral)")
     p.add_argument(
         "--ladder", type=_ladder_type, default=None,
-        help="comma-separated padded batch sizes to pre-compile "
-        "(default 32,128,512; each must divide by the dp mesh axis)",
+        help="comma-separated GLOBAL padded batch sizes to pre-compile "
+        "(each must be a multiple of the dp mesh axis). Default: auto — "
+        "the per-device base ladder 32,128,512 scaled by dp, so one "
+        "invocation drives any mesh width (docs/SERVING.md "
+        "'Mesh-sharded sessions')",
     )
     p.add_argument("--max-queue", type=int, default=None,
                    help="bounded request queue size (full -> 503 + Retry-After)")
@@ -1271,12 +1349,13 @@ def build_parser() -> argparse.ArgumentParser:
         "directory (recommended when binding beyond localhost)",
     )
     p.add_argument(
-        "--workers", type=int, default=None,
+        "--workers", type=_workers_type, default=None,
         help="fleet mode: fork this many worker serve processes (each "
         "owning a device slice) behind a supervising front end that "
         "restarts crashed/hung workers and fails requests over "
-        "(default 0 = classic single process; docs/SERVING.md "
-        "'Multi-worker topology')",
+        "(default 0 = classic single process; 'auto' = visible devices "
+        "/ --devices-per-worker, refusing to oversubscribe the host; "
+        "docs/SERVING.md 'Multi-worker topology')",
     )
     p.add_argument(
         "--devices-per-worker", type=int, default=None,

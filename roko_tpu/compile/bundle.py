@@ -156,17 +156,24 @@ def export_bundle(
     import jax as _jax
     from jax.experimental import serialize_executable
 
+    from roko_tpu.config import resolve_ladder, validate_ladder
     from roko_tpu.infer import make_predict_step
     from roko_tpu.parallel.mesh import AXIS_DP, make_mesh
 
     mesh = mesh or make_mesh(cfg.mesh)
-    rungs = tuple(sorted(set(ladder if ladder is not None else cfg.serve.ladder)))
+    dp = mesh.shape[AXIS_DP]
+    # same denomination rule as PolishSession: explicit rungs are GLOBAL
+    # batch sizes; None = the config ladder (auto default: per-device
+    # base x dp), so a bundle exported on this mesh loads into a session
+    # on this mesh by construction
+    rungs = (
+        resolve_ladder(cfg.serve, dp)
+        if ladder is None
+        else tuple(sorted(set(ladder)))
+    )
     if not rungs:
         raise ValueError("bundle ladder must name at least one batch size")
-    dp = mesh.shape[AXIS_DP]
-    bad = [r for r in rungs if r <= 0 or r % dp]
-    if bad:
-        raise ValueError(f"ladder rungs {bad} not positive multiples of dp={dp}")
+    validate_ladder(rungs, dp)
 
     model, params_abs, x_abs = _abstract_predict_args(cfg, mesh)
     step = make_predict_step(model, mesh)

@@ -274,10 +274,19 @@ class ServeConfig:
 
     host: str = "127.0.0.1"
     port: int = 8000
-    #: padded batch-size ladder the session pre-compiles; every dispatch
-    #: pads to a rung so no request shape ever triggers a recompile.
-    #: Rungs must each divide by the mesh dp axis.
-    ladder: Tuple[int, ...] = (32, 128, 512)
+    #: padded GLOBAL batch-size ladder the session pre-compiles; every
+    #: dispatch pads to a rung so no request shape ever triggers a
+    #: recompile. Explicit rungs are global batch sizes sharded over the
+    #: mesh dp axis (each must be a positive multiple of dp). The
+    #: default () = AUTO: ``ladder_base`` names the PER-DEVICE shard
+    #: sizes and the session compiles global rungs of ``base * dp`` —
+    #: one config drives any mesh, and the batching plane's slot count
+    #: re-denominates to rung x n_devices automatically (docs/SERVING.md
+    #: "Mesh-sharded sessions")
+    ladder: Tuple[int, ...] = ()
+    #: per-device rung shards the auto ladder scales by the mesh dp
+    #: extent (ignored when ``ladder`` pins explicit global rungs)
+    ladder_base: Tuple[int, ...] = (32, 128, 512)
     #: bounded request queue — submissions beyond this are rejected with
     #: a retry-after instead of growing host memory (backpressure)
     max_queue: int = 64
@@ -332,6 +341,51 @@ class ServeConfig:
             raise ValueError(
                 f"max_queue_age_ms must be >= 0; got {self.max_queue_age_ms}"
             )
+        if not self.ladder_base or any(r <= 0 for r in self.ladder_base):
+            raise ValueError(
+                "ladder_base must name at least one positive per-device "
+                f"rung size; got {self.ladder_base}"
+            )
+
+
+def resolve_ladder(serve: "ServeConfig", dp: int) -> Tuple[int, ...]:
+    """The GLOBAL rung ladder a session on a ``dp``-wide mesh compiles:
+    explicit ``serve.ladder`` rungs pass through (sorted, deduped —
+    validity against dp is the session's/exporter's job, where the mesh
+    is known), and the auto default scales each per-device
+    ``serve.ladder_base`` rung by dp. The ONE place ladder-vs-mesh
+    denomination lives — PolishSession, the AOT bundle exporter, and the
+    batch/streaming tail-rung paths all resolve through here."""
+    if dp < 1:
+        raise ValueError(f"mesh dp axis must be >= 1; got {dp}")
+    if serve.ladder:
+        return tuple(sorted(set(serve.ladder)))
+    return tuple(sorted({r * dp for r in serve.ladder_base}))
+
+
+def validate_ladder(rungs, dp: int, *, flag: str = "--ladder") -> None:
+    """Refuse global rungs that cannot shard over the dp mesh axis,
+    naming the axis and suggesting the nearest valid rungs (a bare
+    "bad list" error sent operators to the source). Shared by
+    PolishSession and the AOT bundle exporter so the CLI surfaces one
+    message everywhere."""
+    bad = [r for r in rungs if r <= 0 or r % dp]
+    if not bad:
+        return
+    def nearest(r: int) -> str:
+        lo = (r // dp) * dp
+        hi = lo + dp
+        # non-positive rungs have no neighbour below: suggest dp itself
+        opts = [v for v in (lo, hi) if v > 0] or [dp]
+        return f"{r} -> " + " or ".join(str(v) for v in dict.fromkeys(opts))
+    raise ValueError(
+        f"ladder rungs {bad} are not positive multiples of the mesh dp "
+        f"axis (dp={dp}): a global rung shards rung/dp windows onto "
+        f"each of the dp devices. Nearest valid: "
+        + "; ".join(nearest(r) for r in sorted(bad))
+        + f". Pick multiples of dp, or leave {flag} unset to auto-scale "
+        "the per-device base ladder by dp."
+    )
 
 
 @dataclass(frozen=True)
@@ -343,7 +397,11 @@ class FleetConfig:
     crashed/hung/breaker-tripped workers."""
 
     #: worker process count; 0 = classic single-process `roko-tpu serve`
-    #: (no supervisor, no fleet)
+    #: (no supervisor, no fleet); -1 = AUTO (`--workers auto`): visible
+    #: devices / devices-per-worker (1 when unset), resolved by the
+    #: supervisor via ``parallel.mesh.visible_device_count`` WITHOUT
+    #: initialising a jax backend — a host is never silently
+    #: oversubscribed (docs/SERVING.md "Mesh-sharded sessions")
     workers: int = 0
     #: devices each worker may see (visible-device pinning via
     #: ``parallel.mesh.fleet_worker_env``); 0 = no pinning — every
@@ -558,8 +616,10 @@ class RokoConfig:
             train=TrainConfig(**raw.get("train", {})),
             data=DataConfig(**raw.get("data", {})),
             mesh=MeshConfig(**raw.get("mesh", {})),
-            serve=ServeConfig(**{k: tuple(v) if k == "ladder" else v
-                                 for k, v in raw.get("serve", {}).items()}),
+            serve=ServeConfig(**{
+                k: tuple(v) if k in ("ladder", "ladder_base") else v
+                for k, v in raw.get("serve", {}).items()
+            }),
             fleet=FleetConfig(**raw.get("fleet", {})),
             pipeline=PipelineConfig(**raw.get("pipeline", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),

@@ -441,8 +441,12 @@ def run_inference(
     # executable); the single short TAIL batch pads only to the nearest
     # serve-ladder rung instead of all the way up to batch_size, so a
     # 1-window tail on a --b 2048 run stops paying 2047 rows of wasted
-    # compute for one extra (one-off, never steady-state) compile
-    rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
+    # compute for one extra (one-off, never steady-state) compile.
+    # The ladder resolves through the session's denomination rule (auto
+    # default = per-device base rungs x this mesh's dp)
+    from roko_tpu.config import resolve_ladder
+
+    rungs = tail_rungs(resolve_ladder(cfg.serve, dp), batch_size, dp)
     if cfg.compile.bundle_dir:
         predict = wrap_predict(
             predict,

@@ -107,6 +107,18 @@ def fleet_worker_slice(
     return list(range(first, first + devices_per_worker))
 
 
+def _default_backend() -> Optional[str]:
+    """Backend name from ``JAX_PLATFORMS`` — the supervisor-side sniff
+    shared by :func:`fleet_worker_env`, :func:`visible_device_count`,
+    and :func:`resolve_fleet_topology`, which must all agree WITHOUT
+    initialising a jax backend."""
+    import os
+
+    return (
+        (os.environ.get("JAX_PLATFORMS") or "").split(",")[0].strip() or None
+    )
+
+
 def fleet_worker_env(
     worker_index: int,
     num_workers: int,
@@ -134,10 +146,7 @@ def fleet_worker_env(
     if devices_per_worker <= 0:
         return {}
     if backend is None:
-        backend = (
-            (os.environ.get("JAX_PLATFORMS") or "").split(",")[0].strip()
-            or None
-        )
+        backend = _default_backend()
     env: "dict[str, str]" = {}
     if backend == "cpu":
         # virtual host devices are per-process: each worker simply
@@ -166,6 +175,117 @@ def fleet_worker_env(
     if backend in (None, "gpu", "cuda", "rocm"):
         env["CUDA_VISIBLE_DEVICES"] = ids
     return env
+
+
+def visible_device_count(backend: Optional[str] = None) -> Optional[int]:
+    """How many accelerator devices THIS process (or a child inheriting
+    its environment) would see — computed WITHOUT touching any jax
+    device API, because the fleet supervisor calls it and must never
+    initialise a backend (on TPU that would claim the workers' chips).
+
+    Sources, per backend (``backend`` defaults from ``JAX_PLATFORMS``):
+
+    - ``cpu``: the ``--xla_force_host_platform_device_count`` XLA flag
+      (jax's virtual host devices); absent = 1, jax's CPU default;
+    - ``tpu``: ``TPU_VISIBLE_DEVICES`` when set, else the ``/dev/accel*``
+      device nodes a TPU VM exposes (megacore chips count once, matching
+      ``fleet_worker_env``'s id space);
+    - ``gpu``: ``CUDA_VISIBLE_DEVICES`` when set, else ``/dev/nvidia[0-9]*``.
+
+    Returns None when the count cannot be determined (e.g. a TPU backend
+    with no local evidence) — callers must then refuse auto topology and
+    ask for an explicit count rather than guess."""
+    import glob
+    import os
+    import re
+
+    if backend is None:
+        backend = _default_backend()
+    if backend == "cpu":
+        m = re.search(
+            r"--xla_force_host_platform_device_count=(\d+)",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        return int(m.group(1)) if m else 1
+    if backend in (None, "tpu"):
+        ids = os.environ.get("TPU_VISIBLE_DEVICES")
+        if ids:
+            return len([t for t in ids.split(",") if t.strip()])
+        accels = glob.glob("/dev/accel[0-9]*")
+        if accels:
+            return len(accels)
+        if backend == "tpu":
+            return None
+    if backend in (None, "gpu", "cuda", "rocm"):
+        # ROCm hosts expose HIP_VISIBLE_DEVICES, not the CUDA evidence
+        for var in ("CUDA_VISIBLE_DEVICES", "HIP_VISIBLE_DEVICES"):
+            ids = os.environ.get(var)
+            if ids is not None:
+                return len([t for t in ids.split(",") if t.strip()])
+        nvidia = glob.glob("/dev/nvidia[0-9]*")
+        if nvidia:
+            return len(nvidia)
+    return None
+
+
+def resolve_fleet_topology(fleet_cfg, backend: Optional[str] = None):
+    """Resolve ``--workers auto`` and refuse oversubscription; returns a
+    (possibly updated) FleetConfig. Pure env/config computation (no jax)
+    so the supervisor can call it before spawning anything.
+
+    - ``workers == -1`` (auto): ``visible devices // devices_per_worker``
+      (1 per worker when pinning is unset) — and pinning is turned ON
+      for the resolved slice so a host is never silently oversubscribed.
+      An undeterminable device count refuses with an actionable error.
+    - explicit ``workers`` with ``devices_per_worker > 0``: on
+      accelerator backends a worker count x mesh size exceeding the
+      visible chips refuses loudly instead of letting N workers fight
+      over the same silicon. On CPU the refusal does NOT apply: host
+      "devices" are per-process virtual constructs — each worker child
+      re-pins its own ``--xla_force_host_platform_device_count`` slice
+      (``fleet_worker_env``), so there is no shared id space to
+      oversubscribe."""
+    import dataclasses
+
+    fc = fleet_cfg
+    if backend is None:
+        backend = _default_backend()
+    n = visible_device_count(backend)
+    if fc.workers == -1:
+        per = fc.devices_per_worker if fc.devices_per_worker > 0 else 1
+        if n is None:
+            raise ValueError(
+                "--workers auto: cannot determine the visible device "
+                "count on this host (no TPU_VISIBLE_DEVICES / "
+                "/dev/accel* / CUDA_VISIBLE_DEVICES evidence); pass an "
+                "explicit --workers N --devices-per-worker K"
+            )
+        workers = n // per
+        if workers < 1:
+            raise ValueError(
+                f"--workers auto: {n} visible device(s) cannot host even "
+                f"one worker of {per} device(s) (--devices-per-worker); "
+                "reduce the per-worker mesh or pass --workers explicitly"
+            )
+        fc = dataclasses.replace(
+            fc, workers=workers, devices_per_worker=per
+        )
+    if (
+        backend != "cpu"
+        and fc.workers > 0
+        and fc.devices_per_worker > 0
+        and n is not None
+    ):
+        need = fc.workers * fc.devices_per_worker
+        if need > n:
+            raise ValueError(
+                f"fleet topology oversubscribes the host: {fc.workers} "
+                f"worker(s) x {fc.devices_per_worker} device(s) each = "
+                f"{need} > {n} visible device(s). Use --workers auto, or "
+                f"at most {n // fc.devices_per_worker} worker(s) at this "
+                "mesh size."
+            )
+    return fc
 
 
 def put_replicated(tree, mesh: Mesh):

@@ -16,21 +16,33 @@ a host-side producer pipeline feeds the device through bounded buffers.
     producer thread ── bounded queue.Queue(queue_regions) ──┐  backpressure:
         │ optional tee -> DataWriter (--keep-hdf5)          │  full queue
         ▼                                                   │  blocks workers
-    batcher generator (cut to batch_size, deadline flush,
-        pad partials to the serve ladder — no novel shapes)
-        │ runs inside prefetch_to_device's stage thread
-        ▼
-    device predict (jit, one-deep software pipeline)
-        │ preds
+    consumer: submit each block to the SAME ContinuousBatcher
+        serve runs (serve/scheduler.py) — windows from adjacent
+        regions pack densely into ladder-rung device steps on a
+        mesh-sharded PolishSession; no novel shapes, one
+        padding_efficiency metric for serve AND polish
+        │ preds (futures, drained with bounded lookahead)
         ▼
     VoteBoard.add (incremental)  ──  contig's last window voted
                                        └─> stitch + FASTA write NOW
+
+One batching plane (ROADMAP item 2, the seam PIPELINE.md used to
+carve out): the deadline batcher that padded partial batches up to a
+ladder rung is gone — ``roko-tpu polish`` and ``roko-tpu serve`` now
+share the dense segment-packing scheduler, the warm
+:class:`~roko_tpu.serve.session.PolishSession` (mesh-sharded predict,
+AOT bundles, split compile/predict watchdog budgets, permanent
+host-CPU hang fail-over), and the ``padding_efficiency`` metric from
+one :class:`~roko_tpu.serve.metrics.ServeMetrics` code path.
+``--batch-delay-ms`` maps onto the scheduler's ``max_queue_age_ms``
+(the oldest queued window's padded-flush bound).
 
 Failure propagation: a worker exception travels through the region
 queue as an ``("error", exc)`` item and re-raises in the caller —
 never a silent deadlock. Abandoning the consumer (exception in the
 predict loop, generator close) sets a stop event that every producer
-``put`` polls, so no thread is left parked on a full queue.
+``put`` polls, so no thread is left parked on a full queue; the
+batcher's ``stop`` fails any in-flight futures loudly.
 
 Output identity: votes are order-independent sums and the predict step
 is batch-padding invariant (tests/test_infer.py), so the streamed
@@ -46,43 +58,25 @@ import os
 import queue
 import threading
 import time
-from collections import Counter, deque
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 from roko_tpu import constants as C
-from roko_tpu.compile import load_bundle, wrap_predict
-from roko_tpu.compile.cache import enable_persistent_cache
-from roko_tpu.config import RokoConfig
+from roko_tpu.config import RokoConfig, resolve_ladder
 from roko_tpu.data.hdf5 import DataWriter
 from roko_tpu.features.pipeline import open_region_stream
 from roko_tpu.io.fasta import write_fasta_record
-from roko_tpu.infer import (
-    VoteBoard,
-    make_cpu_predict,
-    make_predict_step,
-    pad_windows,
-    rung_for,
-    tail_rungs,
-)
-from roko_tpu.resilience import (
-    DeadlinePolicy,
-    HangError,
-    PolishJournal,
-    call_with_deadline,
-)
+from roko_tpu.infer import VoteBoard, tail_rungs
+from roko_tpu.resilience import PolishJournal
 from roko_tpu.resilience.watchdog import thread_stack
-from roko_tpu.models.model import RokoModel
-from roko_tpu.parallel.mesh import (
-    AXIS_DP,
-    data_sharding,
-    make_mesh,
-    replicated_sharding,
-)
-from roko_tpu.training.data import prefetch_to_device
+from roko_tpu.parallel.mesh import AXIS_DP, make_mesh
+from roko_tpu.serve.metrics import ServeMetrics
+from roko_tpu.serve.scheduler import ContinuousBatcher
+from roko_tpu.serve.session import PolishSession
 from roko_tpu.utils.profiling import StageTimer, device_trace
 
 Params = Dict[str, Any]
@@ -225,108 +219,6 @@ class _RegionProducer:
         self._put((_END, None))
 
 
-def _device_batches(
-    q: "queue.Queue",
-    batch_size: int,
-    deadline_s: float,
-    stop: threading.Event,
-) -> Iterator[tuple]:
-    """Cut ``(names, positions, examples, n, completions)`` device
-    batches from the region queue.
-
-    Full batches are exactly ``batch_size`` windows. A PARTIAL batch is
-    flushed when the queue has been empty for ``deadline_s`` since its
-    first window arrived — the extractor is the bottleneck right then,
-    and parking windows to chase a full batch would idle the device for
-    nothing (the caller pads partials to the serve ladder, so no novel
-    shape reaches the compiler). ``completions`` carries the
-    ``("done", ...)`` notices consumed since the previous yield."""
-    pending: deque = deque()  # [contig, positions, examples, offset]
-    total = 0
-    completions: List[Tuple[str, int]] = []
-    first_t = 0.0
-    end = False
-
-    def cut(size: int) -> tuple:
-        nonlocal total
-        names: List[str] = []
-        ps: List[np.ndarray] = []
-        xs: List[np.ndarray] = []
-        need = size
-        while need:
-            rec = pending[0]
-            contig, pos, x, off = rec
-            take = min(need, len(pos) - off)
-            names.extend([contig] * take)
-            ps.append(pos[off : off + take])
-            xs.append(x[off : off + take])
-            if off + take == len(pos):
-                pending.popleft()
-            else:
-                rec[3] = off + take
-            need -= take
-        total -= size
-        p = ps[0] if len(ps) == 1 else np.concatenate(ps)
-        xx = xs[0] if len(xs) == 1 else np.concatenate(xs)
-        comps, completions[:] = list(completions), []
-        return names, p, xx, size, comps
-
-    while True:
-        while total < batch_size and not end:
-            # two phases (the serve MicroBatcher's shape): already-queued
-            # blocks coalesce unconditionally — even when the deadline
-            # expired while the consumer was busy voting, a waiting
-            # backlog must still form full batches or device throughput
-            # collapses into a stream of under-filled padded dispatches;
-            # the deadline only bounds how long a partial batch waits
-            # for NEW arrivals
-            try:
-                item = q.get_nowait()
-            except queue.Empty:
-                timeout = 0.25
-                if total:
-                    remaining = deadline_s - (time.perf_counter() - first_t)
-                    if remaining <= 0:
-                        break  # deadline: flush the partial batch
-                    timeout = min(remaining, 0.25)
-                try:
-                    item = q.get(timeout=timeout)
-                except queue.Empty:
-                    if stop.is_set():
-                        return
-                    if completions and not total:
-                        # don't sit on a contig-complete notice while
-                        # the extractor grinds an unrelated region: the
-                        # consumer stitches + writes that contig NOW
-                        comps, completions = completions, []
-                        yield [], None, None, 0, comps
-                    continue
-            tag = item[0]
-            if tag == _BLOCK:
-                if total == 0:
-                    first_t = time.perf_counter()
-                pending.append([item[1], item[2], item[3], 0])
-                total += len(item[2])
-            elif tag == _DONE:
-                completions.append((item[1], item[2]))
-            elif tag == _ERROR:
-                raise item[1]
-            else:  # _END
-                end = True
-        if total:
-            yield cut(batch_size if total >= batch_size else total)
-            if total:
-                # leftover windows inherit a fresh deadline (approximate
-                # age — the deadline is a latency bound, not a contract)
-                first_t = time.perf_counter()
-            continue
-        if completions:
-            comps, completions = completions, []
-            yield [], None, None, 0, comps
-        if end:
-            return
-
-
 def _journal_identity(cfg: RokoConfig, params) -> Dict[str, Any]:
     """Everything, besides ref/bam/seed, that the polished bytes depend
     on: the model weights and the window/extraction geometry. A resume
@@ -370,6 +262,8 @@ def run_streaming_polish(
     trace_dir: Optional[str] = None,
     log=print,
     timer: Optional[StageTimer] = None,
+    metrics: Optional[ServeMetrics] = None,
+    session: Optional[PolishSession] = None,
     vote_sparse_threshold: Optional[int] = None,
     job_retries: int = 1,
     job_timeout: Optional[float] = None,
@@ -388,17 +282,23 @@ def run_streaming_polish(
     out-of-order and faulting sources through it). Single-host only:
     pods keep the staged contig-sharded path (``polish_to_fasta``).
 
+    One batching plane (docs/PIPELINE.md): the device half IS the serve
+    stack — a warm mesh-sharded :class:`PolishSession` (ladder resolved
+    per the serve denomination rule, capped at ``batch_size``; AOT
+    bundle, split compile/predict watchdog budgets, permanent host-CPU
+    hang fail-over via ``cfg.resilience.hang_fallback == "cpu"``)
+    driven by the :class:`ContinuousBatcher`. ``metrics`` (a
+    :class:`ServeMetrics`, created when not given) accumulates the same
+    ``padding_efficiency`` serve exports; ``session`` injects a
+    pre-warmed session (the bench pipeline suite shares one across
+    modes).
+
     Resilience (roko_tpu/resilience; docs/PIPELINE.md "Failure
     handling"): when ``out_path`` is given every finished contig is
     durably committed to a sidecar journal (``<out>.resume/``) before
     it reaches the FASTA; ``resume=True`` reloads a matching journal,
     skips extraction for committed contigs, and the final FASTA is
-    byte-identical to an uninterrupted run. Device compile/predict
-    calls run under ``cfg.resilience.predict_deadline_s`` — on a hang
-    the watchdog dumps thread stacks and either raises
-    :class:`HangError` (nonzero exit) or, with
-    ``cfg.resilience.hang_fallback == "cpu"``, finishes the run on a
-    host-CPU predict step."""
+    byte-identical to an uninterrupted run."""
     if jax.process_count() > 1:
         raise RuntimeError(
             "streaming polish is single-host; run the staged features + "
@@ -413,46 +313,19 @@ def run_streaming_polish(
     deadline_s = (
         pcfg.max_batch_delay_ms if batch_delay_ms is None else batch_delay_ms
     ) / 1e3
-    mesh = mesh or make_mesh(cfg.mesh)
+    mesh = mesh or (session.mesh if session is not None else make_mesh(cfg.mesh))
     dp = mesh.shape[AXIS_DP]
     if batch_size % dp:
         raise ValueError(f"batch_size {batch_size} not divisible by dp={dp}")
 
-    # cold-start tier (roko_tpu/compile): persistent compilation cache
-    # on by default — a crash-resume or fail-over rerun of this exact
-    # program is a disk hit, not an XLA run — and, when configured, the
-    # AOT bundle replaces the compile entirely (digest-checked; a
-    # mismatch refuses loudly rather than polishing with the wrong
-    # program)
-    enable_persistent_cache(cfg.compile)
-    model = RokoModel(cfg.model)
-    # conversion-time weight-only quantization (models/quant.py), as
-    # run_inference/PolishSession: raw f32 params convert here when the
-    # config asks; already-quantized params pass through
+    # conversion-time weight-only quantization (models/quant.py) BEFORE
+    # the journal identity hash: the identity must cover the bytes that
+    # actually predict (the session's own maybe_quantize then passes the
+    # already-quantized tree through untouched)
     from roko_tpu.models.quant import maybe_quantize
 
-    params = maybe_quantize(params, model.cfg)
-    params_host = params  # kept host-side for the CPU hang fail-over
-    params = jax.device_put(params, replicated_sharding(mesh))
-    predict = make_predict_step(model, mesh)
-    sharding = data_sharding(mesh)
-    # partial/tail batches pad to the serve ladder (plus batch_size), so
-    # deadline flushes never hand the compiler a novel shape
-    rungs = tail_rungs(cfg.serve.ladder, batch_size, dp)
-    if cfg.compile.bundle_dir:
-        predict = wrap_predict(
-            predict,
-            load_bundle(
-                cfg.compile.bundle_dir, cfg, mesh=mesh, rungs=rungs, log=log
-            ),
-        )
+    params = maybe_quantize(params, cfg.model)
     timer = timer if timer is not None else StageTimer()
-    rcfg = cfg.resilience
-    # split watchdog budgets per padded shape: first dispatch (compile)
-    # gets compile_deadline_s, steady state predict_deadline_s
-    deadlines = DeadlinePolicy(
-        rcfg.predict_deadline_s, rcfg.compile_deadline_s
-    )
 
     if resume and not out_path:
         raise ValueError(
@@ -470,7 +343,7 @@ def run_streaming_polish(
         committed = journal.open(
             dict(
                 {"ref": str(ref_path), "bam": str(bam_x), "seed": seed},
-                **_journal_identity(cfg, params_host),
+                **_journal_identity(cfg, params),
             ),
             resume=resume,
             log=log,
@@ -478,6 +351,29 @@ def run_streaming_polish(
 
     with contextlib.ExitStack() as stack:
         stack.callback(lambda: journal and journal.close())
+        if session is None:
+            # the serve session IS the device plane: steady-state
+            # batches dispatch at batch_size, short tails pad to the
+            # serve ladder's smaller rungs (tail_rungs caps the
+            # resolved global ladder at batch_size) — no novel shapes,
+            # zero steady-state recompiles. warmup honours cfg.compile
+            # (persistent cache, AOT bundle — require_all=False: rungs
+            # the bundle lacks fall back to jit instead of refusing the
+            # run). Built AFTER the journal opens so a warmup failure
+            # (e.g. a wedged device tripping the watchdog) still leaves
+            # the journal behind for --resume.
+            session = PolishSession(
+                params, cfg, mesh=mesh,
+                ladder=tail_rungs(
+                    resolve_ladder(cfg.serve, dp), batch_size, dp
+                ),
+            )
+            # require_all=False: rungs the bundle lacks fall back to
+            # jit; compile_missing=False: bundle-less rungs compile
+            # lazily on first dispatch (a short polish must not pay XLA
+            # for tail rungs it never uses — serve warms eagerly, batch
+            # jobs lazily, same session either way)
+            session.warmup(require_all=False, compile_missing=False, log=log)
         if region_source is None:
             region_source = stack.enter_context(
                 open_region_stream(
@@ -571,91 +467,58 @@ def run_streaming_polish(
                     with timer("write_fasta"):
                         writer.add(name, seq)
 
-        # Device watchdog (roko_tpu/resilience): every compile/predict
-        # interaction runs under cfg.resilience.predict_deadline_s. On a
-        # hang (the r5 wedge: devices answer, the first XLA compile
-        # never returns) the watchdog dumps thread stacks, emits the
-        # ROKO_WATCHDOG line, and either the HangError propagates to a
-        # nonzero exit or — hang_fallback == "cpu" — the run finishes on
-        # a host-CPU predict step. The padded host batch rides along in
-        # every entry so a fallback can recompute it without touching
-        # the wedged device.
-        cpu_predict: List = [None]  # one-slot box (set-once after a hang)
+        # THE serve batching plane (serve/scheduler.py): each extracted
+        # region block becomes one submitted request; the scheduler
+        # packs windows from adjacent blocks densely into ladder-rung
+        # device steps and age-flushes tails after --batch-delay-ms —
+        # the old pad-to-ladder deadline batcher, subsumed. Hang
+        # fail-over, watchdog budgets, and the zero-recompile ladder
+        # contract all live inside the session the batcher drives.
+        metrics = metrics if metrics is not None else ServeMetrics()
+        metrics.size_classes = tuple(session.ladder)
+        inflight_bound = max(2, prefetch)
+        batcher = ContinuousBatcher(
+            session,
+            metrics=metrics,
+            # the consumer's bounded lookahead (inflight_bound) is the
+            # real admission control; headroom on top so submit() can
+            # never bounce a block with Backpressure
+            max_queue=inflight_bound + queue_regions + 2,
+            max_queue_age_ms=deadline_s * 1e3,
+            rung_upgrade_fill=cfg.serve.rung_upgrade_fill,
+        )
+        #: submitted blocks whose predictions are not yet voted
+        inflight: "deque[Tuple[str, Any, int, Any]]" = deque()
 
-        def fail_over(stage: str):
-            if rcfg.hang_fallback != "cpu":
-                raise  # re-raise the active HangError
-            if cpu_predict[0] is None:
-                log(
-                    f"watchdog: device hung in {stage}; failing over to "
-                    "the host CPU predict step (degraded throughput, "
-                    "completed output)"
-                )
-                cpu_predict[0] = make_cpu_predict(model, params_host)
-            return cpu_predict[0]
-
-        def place(item):
-            names, pos, x, n, comps = item
-            if n == 0:
-                return names, pos, None, None, 0, comps
-            x = pad_windows(x, rung_for(rungs, n))
-            if cpu_predict[0] is not None:
-                # device presumed wedged: stop shipping batches to it
-                return names, pos, None, x, n, comps
-            # device_put dispatches asynchronously; transfer cost shows
-            # up inside "predict+d2h" (same attribution as run_inference)
-            return names, pos, jax.device_put(x, sharding), x, n, comps
-
-        def dispatch(dev, x_padded):
-            """Start one batch's predict: a device future in the happy
-            path, host preds when failed over to CPU."""
-            if cpu_predict[0] is not None or dev is None:
-                fn = cpu_predict[0] or fail_over("predict-dispatch")
-                return "preds", fn(x_padded)
-            deadline_s, first = deadlines.deadline_for(int(dev.shape[0]))
-            try:
-                fut = call_with_deadline(
-                    lambda: predict(params, dev),
-                    deadline_s,
-                    stage=(
-                        "pipeline-predict-compile"
-                        if first
-                        else "pipeline-predict-dispatch"
-                    ),
-                    log=log,
-                )
-                return "fut", fut
-            except BaseException as e:
-                # a failed FIRST dispatch left no executable behind:
-                # re-arm the compile budget for any retry of this shape
-                if first:
-                    deadlines.forget(int(dev.shape[0]))
-                if isinstance(e, HangError):
-                    return "preds", fail_over("predict-dispatch")(x_padded)
-                raise
-
-        def drain(entry) -> int:
-            names, pos, kind, val, x_padded, n, comps = entry
-            if n:
-                with timer("predict+d2h"):
-                    if kind == "fut":
-                        try:
-                            preds = call_with_deadline(
-                                lambda: np.asarray(jax.device_get(val)),
-                                rcfg.predict_deadline_s,
-                                stage="pipeline-predict-fetch",
-                                log=log,
-                            )[:n]
-                        except HangError:
-                            preds = fail_over("predict-fetch")(x_padded)[:n]
-                    else:
-                        preds = val[:n]
-                with timer("vote"):
-                    board.add(names, pos, preds)
-                for name, cnt in Counter(names).items():
-                    voted[name] += cnt
-            for name, total_w in comps:
-                final_counts[name] = total_w
+        def drain_one() -> int:
+            """Vote the oldest in-flight block (blocking on its future);
+            the span measures time BLOCKED on the device plane, as the
+            staged path's predict+d2h."""
+            contig, pos, n, fut = inflight.popleft()
+            with timer("predict+d2h"):
+                # no wall-clock guess here: the session watchdog already
+                # deadlines each device step, and after a CPU hang
+                # fail-over (or under fair-share packing across many
+                # blocks) the honest completion time is unbounded. The
+                # only thing this wait must catch is a DEAD scheduler
+                # thread — a future that can no longer complete fails
+                # loudly instead of parking the run forever.
+                while True:
+                    try:
+                        preds = fut.result(15.0)
+                        break
+                    except TimeoutError:
+                        if not batcher.scheduler_alive() and not fut.done():
+                            # (done() re-checked: the thread may have
+                            # resolved this future in its final act)
+                            raise RuntimeError(
+                                "streaming polish: the batching-plane "
+                                "scheduler thread died with predictions "
+                                "outstanding; aborting the run"
+                            ) from None
+            with timer("vote"):
+                board.add([contig] * n, pos, preds)
+            voted[contig] += n
             finish_ready()
             return n
 
@@ -665,23 +528,36 @@ def run_streaming_polish(
             finish_ready()  # zero-region contigs stitch immediately
             producer.start()
             with device_trace(trace_dir):
-                # one-deep software pipeline (as run_inference): dispatch
-                # batch k+1's predict before blocking on batch k's fetch
-                # + vote, so host voting overlaps device compute
-                pending = None
-                for item in prefetch_to_device(
-                    _device_batches(q, batch_size, deadline_s, stop),
-                    prefetch,
-                    place,
-                ):
-                    names, pos, dev, x_padded, n, comps = item
-                    kind, val = dispatch(dev, x_padded) if n else (None, None)
-                    if pending is not None:
-                        n_windows += drain(pending)
-                    pending = (names, pos, kind, val, x_padded, n, comps)
-                if pending is not None:
-                    n_windows += drain(pending)
+                end = False
+                while not end:
+                    # completed futures vote eagerly (a contig-complete
+                    # notice must not sit behind a grinding extractor)
+                    while inflight and inflight[0][3].done():
+                        n_windows += drain_one()
+                    if len(inflight) >= inflight_bound:
+                        n_windows += drain_one()
+                        continue
+                    try:
+                        item = q.get(timeout=0.25)
+                    except queue.Empty:
+                        continue
+                    tag = item[0]
+                    if tag == _BLOCK:
+                        _, contig, pos, x = item
+                        inflight.append(
+                            (contig, pos, len(pos), batcher.submit(x))
+                        )
+                    elif tag == _DONE:
+                        final_counts[item[1]] = item[2]
+                        finish_ready()
+                    elif tag == _ERROR:
+                        raise item[1]
+                    else:  # _END
+                        end = True
+                while inflight:
+                    n_windows += drain_one()
         finally:
+            batcher.stop()
             stop.set()
             producer.stop.set()
             # unblock a producer parked on a full queue, then reap it
@@ -735,5 +611,15 @@ def run_streaming_polish(
         f"({n_windows / max(dt, 1e-9):.0f} windows/s, "
         f"{n_windows * C.WINDOW_STRIDE / max(dt, 1e-9):.0f} bases/s)"
     )
+    fill = metrics.fill_ratio()
+    if fill is not None:
+        # the SAME series serve exports from /metrics (ServeMetrics
+        # observe_fill via the shared ContinuousBatcher): real windows /
+        # padded rows dispatched — one padding_efficiency for both
+        # planes (docs/PIPELINE.md "One batching plane")
+        log(
+            f"streaming polish: padding_efficiency {fill:.3f} "
+            f"(ladder {session.ladder}, dp={session.dp})"
+        )
     timer.report(log)
     return {name: polished[name] for name in sorted(polished)}

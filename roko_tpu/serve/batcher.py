@@ -63,6 +63,12 @@ class PredictFuture:
         self._req = req
         self._metrics = metrics
 
+    def done(self) -> bool:
+        """True once the result (or its error) is ready — a non-blocking
+        probe for callers draining many futures opportunistically (the
+        streaming polish pipeline rides the batcher this way)."""
+        return self._req.done.is_set()
+
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self._req.done.wait(timeout):
             raise TimeoutError("predict result not ready")

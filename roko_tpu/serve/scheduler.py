@@ -35,6 +35,15 @@ into the very next step, and a huge request under a sustained stream of
 small ones still progresses every step — starvation-free in both
 directions (tests/test_scheduler.py pins both).
 
+The slot pool denominates in the session's GLOBAL ladder (docs/
+SERVING.md "Mesh-sharded sessions"): on an N-device dp mesh the auto
+ladder resolves per-device base rungs x N, so one step is
+``rung * n_devices`` window slots and the slot-slab, occupancy gauge,
+and Retry-After throughput EMA all scale with the mesh automatically.
+The streaming polish pipeline (pipeline/stream.py) drives this same
+class — serve and ``roko-tpu polish`` share ONE batching plane and one
+``padding_efficiency`` metric.
+
 All dispatches go through ``PolishSession.predict``, so only ladder
 shapes ever reach the device — the zero-steady-state-recompile contract
 is untouched. Backpressure is explicit (:class:`Backpressure`, mapped
@@ -209,6 +218,14 @@ class ContinuousBatcher:
             target=self._loop, name="roko-continuous-batcher", daemon=True
         )
         self._thread.start()
+
+    def scheduler_alive(self) -> bool:
+        """True while the scheduling thread can still complete futures —
+        callers that block on a future without their own deadline (the
+        streaming polish pipeline) poll this instead of guessing a
+        wall-clock bound for work whose step count they cannot know."""
+        thread = self._thread
+        return bool(thread is not None and thread.is_alive())
 
     def stop(self, timeout: float = 5.0) -> None:
         """Stop the scheduler: the worker finishes the device step in

@@ -349,6 +349,11 @@ class _Handler(JsonRequestHandler):
                     self.batcher, "BATCHING_MODE", "deadline"
                 ),
                 "compiled": session.cache_size(),
+                # mesh topology this ONE session drives — every ladder
+                # rung shards rung/dp windows per device (getattr:
+                # session stand-ins need not carry a mesh)
+                "mesh_dp": getattr(session, "dp", 1),
+                "devices": getattr(session, "n_devices", 1),
                 # degraded-but-serving: a device hang permanently failed
                 # this session over to host-CPU predict (getattr:
                 # session stand-ins need not model the fail-over)

@@ -28,7 +28,7 @@ from jax.sharding import Mesh
 from roko_tpu.compile import load_bundle, warmup_ladder
 from roko_tpu.compile.cache import enable_persistent_cache
 from roko_tpu.compile.warmup import WarmupReport
-from roko_tpu.config import RokoConfig
+from roko_tpu.config import RokoConfig, resolve_ladder, validate_ladder
 from roko_tpu.infer import (
     make_cpu_predict,
     make_predict_step,
@@ -69,17 +69,24 @@ class PolishSession:
         # (program, backend, jax version) per machine
         enable_persistent_cache(self.cfg.compile)
         self.mesh = mesh or make_mesh(self.cfg.mesh)
-        rungs = tuple(
-            sorted(set(self.cfg.serve.ladder if ladder is None else ladder))
+        #: dp extent of the mesh — every global ladder rung shards
+        #: rung/dp windows onto each of these devices (params replicated)
+        self.dp: int = self.mesh.shape[AXIS_DP]
+        #: total local devices this ONE session drives
+        self.n_devices: int = int(self.mesh.devices.size)
+        # ladder denomination (docs/SERVING.md "Mesh-sharded sessions"):
+        # an explicit `ladder` kwarg (and explicit ServeConfig.ladder /
+        # --ladder rungs) names GLOBAL batch sizes; the auto default
+        # scales the per-device base ladder by dp via resolve_ladder,
+        # so one config drives any mesh width
+        rungs = (
+            resolve_ladder(self.cfg.serve, self.dp)
+            if ladder is None
+            else tuple(sorted(set(ladder)))
         )
         if not rungs:
             raise ValueError("ladder must name at least one batch size")
-        dp = self.mesh.shape[AXIS_DP]
-        bad = [r for r in rungs if r <= 0 or r % dp]
-        if bad:
-            raise ValueError(
-                f"ladder rungs {bad} not positive multiples of dp={dp}"
-            )
+        validate_ladder(rungs, self.dp)
         self.ladder: Tuple[int, ...] = rungs
         self.model = RokoModel(self.cfg.model)
         # conversion-time weight-only quantization (models/quant.py):
@@ -142,6 +149,8 @@ class PolishSession:
         *,
         parallel: Optional[bool] = None,
         bundle_dir: Optional[str] = None,
+        require_all: bool = True,
+        compile_missing: bool = True,
         log=None,
     ) -> int:
         """Make every ladder rung hot; returns the ready-executable
@@ -164,13 +173,17 @@ class PolishSession:
         parallel = ccfg.parallel_warmup if parallel is None else parallel
         mode = None
         if bundle_dir:
+            # require_all=False is the streaming-polish posture: rungs
+            # the bundle lacks (a --b tail size) fall back to the jit
+            # path instead of refusing the whole run; serve keeps the
+            # strict default — a half-AOT service start is a config bug
             self._aot.update(
                 load_bundle(
                     bundle_dir,
                     self.cfg,
                     mesh=self.mesh,
                     rungs=self.ladder,
-                    require_all=True,
+                    require_all=require_all,
                     log=log or (lambda m: None),
                 )
             )
@@ -181,8 +194,19 @@ class PolishSession:
                 np.zeros((rung,) + self._window_shape, np.uint8)
             )
 
+        # compile_missing=False is the batch-pipeline posture: prove the
+        # AOT-loaded rungs (a bundle stub must fail the start, not the
+        # run) but leave bundle-less rungs to compile lazily on first
+        # dispatch — a short polish should not pay XLA for tail rungs it
+        # never uses. Serve keeps the strict default: every rung hot
+        # before /healthz flips from "warming".
+        rungs = (
+            self.ladder
+            if compile_missing
+            else tuple(r for r in self.ladder if r in self._aot)
+        )
         self.warmup_report = warmup_ladder(
-            self.ladder,
+            rungs,
             compile_rung,
             parallel=parallel,
             max_workers=ccfg.warmup_workers,

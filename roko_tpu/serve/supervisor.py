@@ -50,7 +50,7 @@ from http.server import ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from roko_tpu.config import ModelConfig, RokoConfig
-from roko_tpu.parallel.mesh import fleet_worker_env
+from roko_tpu.parallel.mesh import fleet_worker_env, resolve_fleet_topology
 from roko_tpu.serve.fleet import (
     BOOT_VERSION,
     Fleet,
@@ -410,7 +410,13 @@ def run_supervisor(
     version — finalized forward when every worker had already rolled,
     reverted to the journaled incumbent otherwise — loudly, never a
     silently mixed fleet (``serve/rollout.py``)."""
-    fc = cfg.fleet
+    # idempotent for CLI callers (cmd_serve already resolved); the real
+    # guard for programmatic users: --workers auto resolves against the
+    # visible devices and an oversubscribing worker x mesh combination
+    # refuses before anything spawns — all without initialising jax
+    fc = resolve_fleet_topology(cfg.fleet)
+    if fc is not cfg.fleet:
+        cfg = dataclasses.replace(cfg, fleet=fc)
     fleet = Fleet(
         cfg,
         worker_command=(lambda *_: []),  # placeholder; boot spec below

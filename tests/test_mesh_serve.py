@@ -1,0 +1,375 @@
+"""Mesh-sharded serving tests (ROADMAP item 2, docs/SERVING.md
+"Mesh-sharded sessions"): ONE PolishSession drives every local device.
+
+Pinned here, against the conftest's virtual 8-device CPU mesh
+(capability-skipped when jax cannot fake that many devices):
+
+- sharded predict on a 4-device dp mesh is byte-identical to the
+  1-device session on the same windows/params;
+- the auto ladder denominates per device (global rung = base x dp), so
+  the ContinuousBatcher packs ``rung * n_devices`` window slots with
+  zero steady-state recompiles and the occupancy gauge re-denominates;
+- a 1-device AOT bundle REFUSES to load into a 4-device session with a
+  field diff naming the mesh — never a silent recompile;
+- the ladder-validation error names the dp mesh axis and suggests the
+  nearest valid rungs, and surfaces through the `roko-tpu serve` CLI as
+  a clean rc-1 message (no traceback);
+- `--workers auto` resolves workers from the VISIBLE device count
+  without initialising jax, and an oversubscribing worker x mesh
+  combination refuses.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    MeshConfig,
+    ModelConfig,
+    RokoConfig,
+    ServeConfig,
+    resolve_ladder,
+    validate_ladder,
+)
+from roko_tpu.models.model import RokoModel
+from roko_tpu.parallel.mesh import (
+    make_mesh,
+    resolve_fleet_topology,
+    visible_device_count,
+)
+from roko_tpu.serve import ContinuousBatcher, PolishSession
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+ROWS, COLS = 200, 90
+
+needs_4 = pytest.mark.skipif(
+    len(jax.devices()) < 4,
+    reason="needs >= 4 (fake) devices: XLA_FLAGS="
+    "--xla_force_host_platform_device_count=4",
+)
+
+
+def _win(rng, n):
+    return rng.integers(0, C.FEATURE_VOCAB, (n, ROWS, COLS)).astype(np.uint8)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return RokoModel(TINY).init(jax.random.PRNGKey(0))
+
+
+def _session(params, dp, ladder=None, serve=None, **cfg_kw):
+    devs = jax.devices()[:dp]
+    cfg = RokoConfig(
+        model=TINY, serve=serve or ServeConfig(), **cfg_kw
+    )
+    mesh = make_mesh(MeshConfig(dp=dp), devices=devs)
+    return PolishSession(params, cfg, mesh=mesh, ladder=ladder)
+
+
+# -- sharded predict byte-identity -------------------------------------------
+
+
+@needs_4
+def test_sharded_predict_byte_identical_to_single_device(params, rng):
+    """ISSUE acceptance: the 4-device dp-sharded session's predictions
+    equal the 1-device session's on identical windows/params, byte for
+    byte, for every ladder shape incl. padded tails and top-rung
+    chunking."""
+    s1 = _session(params, 1, ladder=(8, 16))
+    s4 = _session(params, 4, ladder=(8, 16))
+    assert (s1.dp, s4.dp) == (1, 4)
+    assert s4.n_devices == 4
+    s1.warmup()
+    s4.warmup()
+    for n in (1, 8, 13, 16, 20, 40):
+        x = _win(rng, n)
+        np.testing.assert_array_equal(s4.predict(x), s1.predict(x))
+    # sharded dispatch stayed on the compiled ladder for both
+    assert s1.dispatched_shapes <= set(s1.ladder)
+    assert s4.dispatched_shapes <= set(s4.ladder)
+
+
+# -- auto ladder x scheduler re-denomination ---------------------------------
+
+
+@needs_4
+def test_auto_ladder_scales_and_scheduler_packs_rung_x_devices(params, rng):
+    """The auto ladder resolves per-device base rungs x dp, so ONE
+    config's ContinuousBatcher packs rung * n_devices window slots —
+    with zero steady-state recompiles across mixed request sizes."""
+    serve = ServeConfig(ladder_base=(2, 4))  # ladder=() -> auto
+    s4 = _session(params, 4, serve=serve)
+    assert s4.ladder == (8, 16)  # (2, 4) x dp=4
+    s4.warmup()
+    compiled = s4.cache_size()
+    cb = ContinuousBatcher(s4, max_queue_age_ms=5.0)
+    try:
+        # backlog >= top rung: the scheduler's slot-slab is one full
+        # top rung = base_top * n_devices windows
+        assert cb.occupancy() == 0.0
+        futs = [cb.submit(_win(rng, n)) for n in (3, 16, 1, 9, 24)]
+        for n, f in zip((3, 16, 1, 9, 24), futs):
+            assert f.result(60.0).shape == (n, COLS)
+    finally:
+        cb.stop()
+    assert s4.cache_size() == compiled  # zero steady-state recompiles
+    assert s4.dispatched_shapes <= set(s4.ladder)
+
+
+def test_resolve_ladder_denomination():
+    assert resolve_ladder(ServeConfig(), 1) == (32, 128, 512)
+    assert resolve_ladder(ServeConfig(), 8) == (256, 1024, 4096)
+    assert resolve_ladder(ServeConfig(ladder_base=(2, 4)), 4) == (8, 16)
+    # explicit rungs are GLOBAL: never scaled
+    assert resolve_ladder(ServeConfig(ladder=(8, 16)), 4) == (8, 16)
+    with pytest.raises(ValueError, match="dp axis must be >= 1"):
+        resolve_ladder(ServeConfig(), 0)
+    with pytest.raises(ValueError, match="ladder_base"):
+        ServeConfig(ladder_base=())
+
+
+def test_config_round_trips_ladder_base():
+    cfg = RokoConfig(serve=ServeConfig(ladder_base=(4, 8)))
+    back = RokoConfig.from_json(cfg.to_json())
+    assert back.serve.ladder_base == (4, 8)
+    assert back.serve.ladder == ()
+
+
+# -- bundle mesh identity refusal --------------------------------------------
+
+
+@needs_4
+def test_one_device_bundle_refuses_four_device_session(params, tmp_path):
+    """A 1-device AOT bundle must refuse to load into a 4-device
+    session with a field diff NAMING the mesh — silently recompiling
+    (or worse, running the 1-device program) is never acceptable."""
+    from roko_tpu.compile import BundleMismatch, export_bundle
+
+    bundle = str(tmp_path / "bundle1")
+    cfg = RokoConfig(model=TINY)
+    mesh1 = make_mesh(MeshConfig(dp=1), devices=jax.devices()[:1])
+    export_bundle(bundle, cfg, mesh=mesh1, ladder=(8,), log=lambda m: None)
+
+    cfg4 = dataclasses.replace(
+        cfg, compile=dataclasses.replace(cfg.compile, bundle_dir=bundle)
+    )
+    mesh4 = make_mesh(MeshConfig(dp=4), devices=jax.devices()[:4])
+    s4 = PolishSession(params, cfg4, mesh=mesh4, ladder=(8,))
+    with pytest.raises(BundleMismatch) as exc:
+        s4.warmup()
+    assert "mesh.dp" in str(exc.value)  # the diff names the mesh field
+    assert "bundle=1" in str(exc.value) and "run=4" in str(exc.value)
+
+
+# -- ladder validation error (ISSUE satellite) -------------------------------
+
+
+def test_ladder_error_names_mesh_axis_and_suggests_nearest(params):
+    with pytest.raises(ValueError) as exc:
+        _session(params, 4, ladder=(6,))
+    msg = str(exc.value)
+    assert "dp axis (dp=4)" in msg
+    assert "6 -> 4 or 8" in msg  # the nearest valid rungs, both sides
+    # pure-helper form used by the exporter too
+    with pytest.raises(ValueError, match="dp axis \\(dp=8\\)"):
+        validate_ladder((12,), 8)
+    # a non-positive rung has no neighbour below: suggest dp itself,
+    # never an empty "-8 -> " fragment
+    with pytest.raises(ValueError, match="-8 -> 8"):
+        validate_ladder((-8,), 8)
+    validate_ladder((8, 16), 8)  # multiples pass silently
+
+
+def test_serve_cli_bad_ladder_exits_1_with_message(tmp_path, capsys):
+    """The same validation message must surface through the
+    `roko-tpu serve` CLI as rc 1 — an operator input error, never a
+    traceback."""
+    from roko_tpu.cli import main
+    from roko_tpu.training.checkpoint import save_params
+
+    ckpt = str(tmp_path / "params")
+    save_params(ckpt, RokoModel(TINY).init(jax.random.PRNGKey(0)))
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        f.write(RokoConfig(model=TINY).to_json())
+    dp = len(jax.devices())
+    rc = main(
+        ["serve", ckpt, "--config", cfg_path, "--port", "0",
+         "--ladder", str(dp + 1)]
+    )
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert f"dp axis (dp={dp})" in err
+    assert "Nearest valid" in err
+
+
+def test_compile_cli_bad_ladder_exits_1_with_message(tmp_path, capsys):
+    from roko_tpu.cli import main
+
+    cfg_path = str(tmp_path / "cfg.json")
+    with open(cfg_path, "w") as f:
+        f.write(RokoConfig(model=TINY).to_json())
+    dp = len(jax.devices())
+    rc = main(
+        ["compile", str(tmp_path / "bundle"), "--config", cfg_path,
+         "--ladder", str(dp + 1), "--no-verify"]
+    )
+    assert rc == 1
+    assert f"dp axis (dp={dp})" in capsys.readouterr().err
+
+
+# -- --workers auto / oversubscription refusal -------------------------------
+
+
+def test_visible_device_count_sources(monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--foo --xla_force_host_platform_device_count=6"
+    )
+    assert visible_device_count() == 6
+    monkeypatch.setenv("XLA_FLAGS", "")
+    assert visible_device_count() == 1  # jax's CPU default
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0,1,2,3")
+    assert visible_device_count() == 4
+    monkeypatch.delenv("TPU_VISIBLE_DEVICES")
+    monkeypatch.setenv("JAX_PLATFORMS", "gpu")
+    monkeypatch.setenv("CUDA_VISIBLE_DEVICES", "0,2")
+    assert visible_device_count() == 2
+
+
+def test_workers_auto_resolves_and_refuses_oversubscription(monkeypatch):
+    from roko_tpu.config import FleetConfig
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+    )
+    # auto: 8 visible / 1 per worker, pinning turned on
+    fc = resolve_fleet_topology(FleetConfig(workers=-1))
+    assert (fc.workers, fc.devices_per_worker) == (8, 1)
+    # auto with a per-worker mesh: 8 / 4 = 2 workers x 4 chips
+    fc = resolve_fleet_topology(
+        FleetConfig(workers=-1, devices_per_worker=4)
+    )
+    assert (fc.workers, fc.devices_per_worker) == (2, 4)
+    # on CPU an explicit workers x mesh past the forced count is NOT
+    # oversubscription: each worker child re-pins its OWN virtual
+    # device count (fleet_worker_env) — no shared silicon to fight over
+    fc = FleetConfig(workers=3, devices_per_worker=4)
+    assert resolve_fleet_topology(fc) is fc
+    # a per-worker mesh larger than the host refuses even under auto
+    with pytest.raises(ValueError, match="cannot host"):
+        resolve_fleet_topology(
+            FleetConfig(workers=-1, devices_per_worker=16)
+        )
+    # unpinned explicit workers on CPU stay untouched (no silent change)
+    fc = FleetConfig(workers=2)
+    assert resolve_fleet_topology(fc) is fc
+    # ACCELERATOR backends do refuse: chips are shared hardware
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0,1,2,3,4,5,6,7")
+    with pytest.raises(ValueError, match="oversubscribes") as exc:
+        resolve_fleet_topology(FleetConfig(workers=3, devices_per_worker=4))
+    assert "12 > 8" in str(exc.value)
+    fc = resolve_fleet_topology(
+        FleetConfig(workers=-1, devices_per_worker=4)
+    )
+    assert (fc.workers, fc.devices_per_worker) == (2, 4)
+
+
+def test_workers_auto_cli_parsing_and_refusal(tmp_path, capsys, monkeypatch):
+    from roko_tpu.cli import _build_config, build_parser, main
+
+    args = build_parser().parse_args(["serve", "ckpt/", "--workers", "auto"])
+    assert _build_config(args).fleet.workers == -1
+    args = build_parser().parse_args(["serve", "ckpt/", "--workers", "2"])
+    assert _build_config(args).fleet.workers == 2
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["serve", "ckpt/", "--workers", "some"])
+    # the supervisor-side refusal surfaces as rc 1 through the CLI,
+    # before any worker (or jax backend) exists — exercised with a fake
+    # TPU env: the resolver is jax-free, so no real chip is needed
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    monkeypatch.setenv("TPU_VISIBLE_DEVICES", "0,1,2,3,4,5,6,7")
+    rc = main(
+        ["serve", "ckpt/", "--workers", "3", "--devices-per-worker", "4"]
+    )
+    assert rc == 1
+    assert "oversubscribes" in capsys.readouterr().err
+
+
+# -- bench mesh suite --------------------------------------------------------
+
+
+def test_bench_mesh_suite_contract():
+    """The mesh suite's contract fields: per-count windows/sec rows,
+    cross-count byte-identity of the predictions, and the scaling
+    efficiency ratios (fresh child process per simulated count)."""
+    from roko_tpu.benchmark import run_mesh_suite
+
+    out = run_mesh_suite(
+        (1, 2), iterations=2, global_batch=32,
+        config_json=RokoConfig(model=TINY).to_json(),
+    )
+    assert out["byte_identical"] is True
+    assert out["rows"]["1"]["windows_per_sec"] > 0
+    assert out["rows"]["2"]["per_device_batch"] == 16
+    assert "2" in out["scaling_efficiency"]
+    with pytest.raises(ValueError, match="divide"):
+        run_mesh_suite((3,), global_batch=32)
+
+
+@pytest.mark.slow
+def test_bench_mesh_suite_acceptance_1_2_4():
+    """ISSUE acceptance: windows/sec at 1/2/4 simulated devices with
+    scaling efficiency >= 0.7 (ideal 1.0 on fake devices — no extra
+    silicon; the real-TPU row is ROADMAP item 6 debt) and
+    byte-identical predictions across every count."""
+    from roko_tpu.benchmark import run_mesh_suite
+
+    out = run_mesh_suite(
+        (1, 2, 4), iterations=4, global_batch=64,
+        config_json=RokoConfig(model=TINY).to_json(),
+    )
+    assert out["byte_identical"] is True
+    assert all(e >= 0.7 for e in out["scaling_efficiency"].values()), out
+    assert set(out["rows"]) == {"1", "2", "4"}
+
+
+# -- healthz topology --------------------------------------------------------
+
+
+@needs_4
+def test_healthz_reports_mesh_topology(params):
+    """/healthz carries mesh_dp + devices so an operator can see how
+    many chips ONE session is actually driving."""
+    import threading
+    import urllib.request
+
+    from roko_tpu.serve import make_server
+
+    s4 = _session(params, 4, ladder=(8,))
+    s4.warmup()
+    srv = make_server(s4, RokoConfig(model=TINY).serve, port=0)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.server_address[1]}/healthz", timeout=10
+        ) as r:
+            body = json.loads(r.read())
+        assert body["mesh_dp"] == 4
+        assert body["devices"] == 4
+        assert body["ladder"] == [8]
+    finally:
+        srv.shutdown()
+        srv.batcher.stop()
+        srv.server_close()
+        thread.join(5.0)

@@ -38,7 +38,6 @@ from roko_tpu.config import (
 from roko_tpu.infer import rung_for
 from roko_tpu.models.model import RokoModel
 from roko_tpu.pipeline import run_streaming_polish
-from roko_tpu.pipeline import stream as stream_mod
 from roko_tpu.resilience import (
     CircuitBreaker,
     HangError,
@@ -366,29 +365,36 @@ def _blocking_predict_step(model, mesh):
     return predict
 
 
-def test_streaming_hang_watchdog_aborts(synthetic, monkeypatch, tmp_path):
+def test_streaming_hang_watchdog_aborts(
+    synthetic, monkeypatch, tmp_path, capsys
+):
     """ISSUE acceptance: a forever-blocking predict trips the watchdog
     within the deadline, logs the stack diagnostic, fails the run
     (nonzero exit through the CLI), and tears down without deadlock or
-    non-daemon thread leaks."""
-    monkeypatch.setattr(stream_mod, "make_predict_step", _blocking_predict_step)
+    non-daemon thread leaks. The predict plane is now the serve
+    session (one batching plane, docs/PIPELINE.md), so the fake wedges
+    the session's warmup dispatch and the split budget bills it as the
+    serve-compile stage."""
+    import roko_tpu.serve.session as session_mod
+
+    monkeypatch.setattr(
+        session_mod, "make_predict_step", _blocking_predict_step
+    )
     non_daemon_before = {t for t in threading.enumerate() if not t.daemon}
     out = str(tmp_path / "never.fasta")
-    msgs = []
     t0 = time.monotonic()
-    # the fake wedges the FIRST dispatch of its shape, which the split
-    # watchdog budget bills as the compile stage (roko_tpu/compile)
-    with pytest.raises(HangError, match="pipeline-predict-compile"):
+    with pytest.raises(HangError, match="serve-compile"):
         run_streaming_polish(
             None, None, synthetic.params, HANG_CFG,
-            out_path=out, batch_size=16, log=msgs.append,
+            out_path=out, batch_size=16, log=lambda *a: None,
             region_source=_source(
                 synthetic.refs, synthetic.counts, synthetic.results
             ),
         )
     assert time.monotonic() - t0 < 30.0  # no hang, no deadlocked teardown
-    joined = "\n".join(msgs)
-    assert "ROKO_WATCHDOG hang stage=pipeline-predict-compile" in joined
+    # the session's watchdog diagnostic goes to stderr (shared with the
+    # serve tier; the CLI surfaces it either way)
+    assert "ROKO_WATCHDOG hang stage=serve-compile" in capsys.readouterr().err
     # no half-written output, and the journal survives for --resume
     assert not (tmp_path / "never.fasta").exists()
     assert (tmp_path / "never.fasta.resume").is_dir()
@@ -397,9 +403,13 @@ def test_streaming_hang_watchdog_aborts(synthetic, monkeypatch, tmp_path):
     } == non_daemon_before
 
 
-def test_streaming_hang_falls_over_to_cpu(synthetic, monkeypatch, tmp_path):
+def test_streaming_hang_falls_over_to_cpu(
+    synthetic, monkeypatch, tmp_path, capsys
+):
     """With hang_fallback=cpu the same wedged device yields a COMPLETED
-    run whose output is byte-identical to a healthy one."""
+    run whose output is byte-identical to a healthy one — now through
+    the shared session's permanent host-CPU fail-over (the same path
+    serve uses, docs/PIPELINE.md "One batching plane")."""
     import dataclasses
 
     clean_out = str(tmp_path / "clean.fasta")
@@ -412,7 +422,11 @@ def test_streaming_hang_falls_over_to_cpu(synthetic, monkeypatch, tmp_path):
     )
     assert not (tmp_path / "clean.fasta.resume").exists()  # finalized
 
-    monkeypatch.setattr(stream_mod, "make_predict_step", _blocking_predict_step)
+    import roko_tpu.serve.session as session_mod
+
+    monkeypatch.setattr(
+        session_mod, "make_predict_step", _blocking_predict_step
+    )
     cfg = dataclasses.replace(
         HANG_CFG,
         resilience=ResilienceConfig(
@@ -421,19 +435,18 @@ def test_streaming_hang_falls_over_to_cpu(synthetic, monkeypatch, tmp_path):
         ),
     )
     out = str(tmp_path / "fallback.fasta")
-    msgs = []
     polished = run_streaming_polish(
         None, None, synthetic.params, cfg,
-        out_path=out, batch_size=16, log=msgs.append,
+        out_path=out, batch_size=16, log=lambda *a: None,
         region_source=_source(
             synthetic.refs, synthetic.counts, synthetic.results
         ),
     )
     assert polished == clean
     assert open(out, "rb").read() == open(clean_out, "rb").read()
-    joined = "\n".join(msgs)
-    assert "ROKO_WATCHDOG hang" in joined
-    assert "failing over to the host CPU" in joined
+    err = capsys.readouterr().err
+    assert "ROKO_WATCHDOG hang" in err
+    assert "ROKO_FAILOVER" in err and "host-CPU" in err
 
 
 def test_streaming_resume_skips_committed_contigs(synthetic, tmp_path):
@@ -459,13 +472,13 @@ def test_streaming_resume_skips_committed_contigs(synthetic, tmp_path):
             committed_evt.set()
 
     def faulting():
-        # ctg0's whole block + done notice, then ctg1's block (the
-        # one-deep predict pipeline drains batch k only when batch k+1
-        # exists — without a second item ctg0 would never finish), then
-        # wait for the consumer to durably commit ctg0 before crashing:
-        # deterministic "died mid-run with one contig landed"
+        # ctg0's whole block + done notice, then wait for the consumer
+        # to durably commit it before crashing: deterministic "died
+        # mid-run with one contig landed". (The continuous batching
+        # plane drains eagerly — the old one-deep pipeline needed a
+        # second item queued before batch k finished; now yielding
+        # ctg1's block too would let BOTH contigs commit pre-crash.)
         yield synthetic.results[0]
-        yield synthetic.results[1]
         assert committed_evt.wait(30.0), "ctg0 was never committed"
         raise RuntimeError("injected crash after first commit")
 

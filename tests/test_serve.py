@@ -283,8 +283,18 @@ def test_cli_serve_flags_layer_into_config():
     assert cfg.serve.max_delay_ms == 5.0
     assert cfg.serve.host == "127.0.0.1"  # default preserved
 
+    # the default ladder is AUTO: () resolves to the per-device base
+    # rungs scaled by the mesh dp axis, so ONE config drives any mesh
+    # (docs/SERVING.md "Mesh-sharded sessions")
+    from roko_tpu.config import resolve_ladder
+
     defaults = _build_config(build_parser().parse_args(["serve", "ckpt/"]))
-    assert defaults.serve.ladder == (32, 128, 512)
+    assert defaults.serve.ladder == ()
+    assert defaults.serve.ladder_base == (32, 128, 512)
+    assert resolve_ladder(defaults.serve, 1) == (32, 128, 512)
+    assert resolve_ladder(defaults.serve, 4) == (128, 512, 2048)
+    # explicit rungs are GLOBAL and pass through unscaled
+    assert resolve_ladder(cfg.serve, 8) == (8, 16)
 
 
 # -- HTTP end to end ---------------------------------------------------------

@@ -68,7 +68,10 @@ def project(tmp_path_factory):
 
     cfg = RokoConfig(
         model=TINY,
-        mesh=MeshConfig(dp=8),
+        # dp=-1 absorbs however many fake devices the env forces (the
+        # conftest's 8, or the mesh-serve CI lane's 4) — the identity
+        # contract must hold at any mesh width
+        mesh=MeshConfig(dp=-1),
         region=RegionConfig(size=1200, overlap=100),
     )
     params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
@@ -267,6 +270,58 @@ def test_worker_exception_propagates_under_full_queue(project):
                 refs=refs, region_counts=counts, results=faulting()
             ),
         )
+
+
+def test_padding_efficiency_reported_from_shared_code_path(project, tmp_path):
+    """ISSUE satellite: `roko-tpu polish` and serve report
+    padding_efficiency from ONE code path — the ServeMetrics the shared
+    ContinuousBatcher fills. The streaming run logs it, and the very
+    same metrics object renders the serve /metrics series."""
+    from roko_tpu.serve.metrics import ServeMetrics
+
+    metrics = ServeMetrics()
+    lines = []
+    polished = run_streaming_polish(
+        project.fasta, project.bam, project.params, project.cfg,
+        out_path=str(tmp_path / "eff.fasta"), seed=5, batch_size=16,
+        log=lines.append, metrics=metrics,
+    )
+    assert polished == project.staged  # identity survives the plane swap
+    fill = metrics.fill_ratio()
+    assert fill is not None and 0.0 < fill <= 1.0
+    # the polish CLI surface: one loud padding_efficiency line...
+    eff_lines = [l for l in lines if "padding_efficiency" in l]
+    assert eff_lines and f"{fill:.3f}" in eff_lines[0]
+    # ...and the serve surface: the SAME object renders the /metrics
+    # series serve exports (no second implementation to drift)
+    assert f"roko_serve_padding_efficiency {fill:.4f}" in metrics.render()
+
+
+def test_streaming_uses_continuous_batcher_zero_recompiles(project, tmp_path):
+    """The unified plane keeps the ladder contract: a pre-warmed
+    session injected into the streaming engine sees no new compiled
+    shapes while the pipeline runs (and is reused, proving the serve
+    session IS the polish device plane)."""
+    from roko_tpu.config import resolve_ladder
+    from roko_tpu.infer import tail_rungs
+    from roko_tpu.parallel.mesh import AXIS_DP, make_mesh
+    from roko_tpu.serve.session import PolishSession
+
+    mesh = make_mesh(project.cfg.mesh)
+    dp = mesh.shape[AXIS_DP]
+    session = PolishSession(
+        project.params, project.cfg, mesh=mesh,
+        ladder=tail_rungs(resolve_ladder(project.cfg.serve, dp), 16, dp),
+    )
+    session.warmup()
+    compiled = session.cache_size()
+    polished = run_streaming_polish(
+        project.fasta, project.bam, project.params, project.cfg,
+        seed=5, batch_size=16, log=lambda *a: None, session=session,
+    )
+    assert polished == project.staged
+    assert session.cache_size() == compiled
+    assert session.dispatched_shapes <= set(session.ladder)
 
 
 def test_ordered_fasta_writer_out_of_order(tmp_path):

@@ -60,17 +60,27 @@ def main() -> int:
             print(f"bundle: {bundle} INVALID — {e}")
             continue
         ident = man.get("identity", {})
-        # kind + precision ride in the digested ModelConfig: operators
-        # can tell at a glance which model family AND precision variant
-        # (f32/bf16 compute, int8 weight-only) a cached bundle belongs
-        # to without hashing configs (a mismatch on any of the three
-        # refuses to load — docs/SERVING.md "Precision")
+        # kind + precision + MESH ride in the digested identity:
+        # operators can tell at a glance which model family, precision
+        # variant (f32/bf16 compute, int8 weight-only), and device
+        # topology a cached bundle belongs to without hashing configs —
+        # a mismatch on any of them refuses to load (a 1-device bundle
+        # never silently recompiles inside a 4-device session;
+        # docs/SERVING.md "Mesh-sharded sessions" / "Precision")
         model = ident.get("model") or {}
         kind = model.get("kind", "?")
+        mesh = ident.get("mesh") or {}
+        mesh_s = (
+            f"dp{mesh.get('dp', '?')}xtp{mesh.get('tp', '?')}"
+            f"xsp{mesh.get('sp', '?')}"
+            if mesh
+            else "?"
+        )
         print(
             f"bundle: {bundle} kind={kind} "
             f"compute_dtype={model.get('compute_dtype', '?')} "
             f"quantize={model.get('quantize') or 'none'} "
+            f"mesh={mesh_s} "
             f"digest={man.get('digest', '?')[:12]} "
             f"rungs={man.get('rungs')} backend={ident.get('backend')}/"
             f"{ident.get('device_kind')} jax={ident.get('jax_version')}"
