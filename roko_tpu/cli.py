@@ -212,6 +212,24 @@ def _guard_args(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _obs_args(p: argparse.ArgumentParser) -> None:
+    """Structured event plane knobs (roko_tpu/obs,
+    docs/OBSERVABILITY.md)."""
+    p.add_argument(
+        "--event-log", default=None, metavar="PATH",
+        help="append every ROKO_* event as one JSON record to this "
+        "JSONL file (size-capped rotation, default 64 MiB via "
+        "--event-log-max-mb); the grep-stable stderr one-liners are "
+        "unchanged. Fleet workers suffix .w<id> so processes never "
+        "share a file",
+    )
+    p.add_argument(
+        "--event-log-max-mb", type=float, default=None,
+        help="event-log rotation cap in MiB (PATH -> PATH.1 past it; "
+        "default 64)",
+    )
+
+
 def _window_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--window-rows", type=int, default=None, help="pileup rows per window")
     p.add_argument("--window-cols", type=int, default=None, help="pileup columns per window")
@@ -299,6 +317,8 @@ def _build_config(args: argparse.Namespace):
         ladder="ladder",  # already a tuple via the _ladder_type callback
         batching="batching", max_queue_age_ms="max_queue_age_ms",
         rung_upgrade_fill="rung_upgrade_fill",
+        event_log="event_log", event_log_max_mb="event_log_max_mb",
+        trace_ring="trace_ring",
     )
     pipeline = over(
         base.pipeline,
@@ -333,6 +353,7 @@ def _build_config(args: argparse.Namespace):
         max_rollbacks="max_rollbacks", ema_beta="guard_ema_beta",
         warmup_steps="guard_warmup_steps",
         save_every_steps="save_every_steps",
+        event_log="event_log", event_log_max_mb="event_log_max_mb",
     )
     if getattr(args, "no_guard", None):
         guard = dataclasses.replace(guard, enabled=False)
@@ -362,10 +383,27 @@ def cmd_features(args: argparse.Namespace) -> int:
     return 0
 
 
+def _configure_event_log(
+    path, max_mb: float, worker_id=None
+) -> None:
+    """Install the process-global JSONL event sink
+    (docs/OBSERVABILITY.md). Fleet workers get a per-process suffix so
+    N processes never race one file's rotation."""
+    if not path:
+        return
+    from roko_tpu.obs import configure_event_log
+
+    if worker_id is not None:
+        path = f"{path}.w{worker_id}"
+    configure_event_log(path, max_mb)
+    print(f"obs: event log -> {path}")
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     from roko_tpu.training.loop import train
 
     cfg = _build_config(args)
+    _configure_event_log(cfg.guard.event_log, cfg.guard.event_log_max_mb)
     train(
         cfg, args.train, args.out, val_path=args.val,
         resume=args.resume, trace_dir=args.trace_dir,
@@ -497,6 +535,14 @@ def cmd_polish(args: argparse.Namespace) -> int:
 
     distributed.initialize()  # idempotent; needed for the pod guard
     cfg = _build_config(args)
+    # on a pod every process would otherwise share one JSONL file and
+    # race its rotation — same per-process suffix rule as fleet workers
+    _configure_event_log(
+        cfg.serve.event_log, cfg.serve.event_log_max_mb,
+        worker_id=(
+            jax.process_index() if jax.process_count() > 1 else None
+        ),
+    )
     if args.keep_hdf5 and jax.process_count() > 1:
         raise SystemExit(
             "polish --keep-hdf5 is single-host only: every pod process "
@@ -733,6 +779,10 @@ def cmd_serve(args: argparse.Namespace) -> int:
     import time
 
     cfg = _build_config(args)
+    _configure_event_log(
+        cfg.serve.event_log, cfg.serve.event_log_max_mb,
+        worker_id=args.worker_id,
+    )
     if cfg.fleet.workers != 0 and args.worker_id is None:
         # --workers auto (-1) resolves against the VISIBLE devices and
         # an explicit worker count x mesh size exceeding them refuses —
@@ -1080,6 +1130,7 @@ def build_parser() -> argparse.ArgumentParser:
     _window_args(p)
     _data_args(p)
     _guard_args(p)
+    _obs_args(p)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("inference", help="features HDF5 + checkpoint -> polished FASTA")
@@ -1300,6 +1351,7 @@ def build_parser() -> argparse.ArgumentParser:
     _window_args(p)
     _resilience_args(p)
     _compile_args(p)
+    _obs_args(p)
     p.set_defaults(fn=cmd_polish)
 
     p = sub.add_parser(
@@ -1391,6 +1443,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="fleet mode: canary p99 beyond this multiple of the "
         "incumbent's pre-rollout p99 auto-rolls back (default 3)",
     )
+    p.add_argument(
+        "--trace-ring", type=int, default=None,
+        help="GET /tracez retention: completed request traces kept in "
+        "the last-N ring (default 256; docs/OBSERVABILITY.md)",
+    )
     # fleet-internal plumbing (the supervisor passes these to its
     # children; automation may use --announce to learn a port-0 bind)
     p.add_argument("--worker-id", type=int, default=None,
@@ -1402,6 +1459,7 @@ def build_parser() -> argparse.ArgumentParser:
     _window_args(p)
     _resilience_args(p, serve=True)
     _compile_args(p)
+    _obs_args(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

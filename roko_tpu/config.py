@@ -320,6 +320,16 @@ class ServeConfig:
     #: directory; None = any readable path — acceptable on the default
     #: loopback bind, set this when binding beyond localhost
     data_root: Optional[str] = None
+    #: structured event-log JSONL sink (docs/OBSERVABILITY.md): every
+    #: ROKO_* event also appends one JSON record here, size-capped
+    #: rotation at ``event_log_max_mb``; None = stderr lines only.
+    #: Fleet workers suffix ``.w<id>`` so processes never share a file.
+    event_log: Optional[str] = None
+    event_log_max_mb: float = 64.0
+    #: GET /tracez retention: the last N completed request traces plus
+    #: a slowest-N leaderboard (bounded by construction)
+    trace_ring: int = 256
+    trace_slowest: int = 32
 
     def __post_init__(self) -> None:
         # validate at construction (config layering, JSON load, CLI) so
@@ -345,6 +355,11 @@ class ServeConfig:
             raise ValueError(
                 "ladder_base must name at least one positive per-device "
                 f"rung size; got {self.ladder_base}"
+            )
+        if self.trace_ring < 1 or self.trace_slowest < 1:
+            raise ValueError(
+                "trace_ring/trace_slowest must be >= 1; got "
+                f"{self.trace_ring}/{self.trace_slowest}"
             )
 
 
@@ -583,6 +598,12 @@ class GuardConfig:
     #: --resume replays from exactly that batch; 0 = epoch-boundary
     #: checkpoints only
     save_every_steps: int = 0
+    #: structured event-log JSONL sink for TRAINING runs
+    #: (docs/OBSERVABILITY.md): every ROKO_GUARD skip/rollback/
+    #: ckpt-integrity event also appends one JSON record here,
+    #: size-capped rotation at ``event_log_max_mb``; None = stderr only
+    event_log: Optional[str] = None
+    event_log_max_mb: float = 64.0
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,50 @@
+"""Unified observability plane (docs/OBSERVABILITY.md).
+
+Three legs, one package — the substrate every runtime subsystem
+reports through:
+
+- ``events``  — ONE structured emitter behind every ``ROKO_*`` stderr
+  one-liner (guard, watchdog, failover, rollout, fleet, serve), with an
+  optional JSONL sink (``--event-log``) under size-capped rotation. The
+  legacy grep-stable line formats are preserved byte-for-byte; the JSON
+  record adds ts / subsystem / event / request_id / fields.
+- ``trace``   — request-scoped tracing: a ``request_id`` minted at the
+  front end (or honored from ``X-Roko-Request-Id``) rides the request
+  supervisor -> worker -> scheduler -> device; per-request span
+  breakdowns (queue-wait, pack, device step, scatter, stitch) return in
+  the reply ``timings`` field and land in a bounded in-memory ring
+  served by ``GET /tracez``.
+- ``hist``    — cumulative Prometheus histograms with FIXED buckets, so
+  the fleet supervisor aggregates latency by bucket-sum instead of
+  passing through unmergeable per-worker percentiles.
+"""
+
+from roko_tpu.obs.events import (
+    configure_event_log,
+    emit,
+    event_log_path,
+    format_line,
+    legacy_prefix,
+)
+from roko_tpu.obs.hist import (
+    DEFAULT_LATENCY_BUCKETS,
+    HistogramFamily,
+    parse_histogram_rows,
+    quantile_from_buckets,
+)
+from roko_tpu.obs.trace import RequestTrace, TraceRing, new_request_id
+
+__all__ = [
+    "DEFAULT_LATENCY_BUCKETS",
+    "HistogramFamily",
+    "RequestTrace",
+    "TraceRing",
+    "configure_event_log",
+    "emit",
+    "event_log_path",
+    "format_line",
+    "legacy_prefix",
+    "new_request_id",
+    "parse_histogram_rows",
+    "quantile_from_buckets",
+]

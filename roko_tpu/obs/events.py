@@ -1,0 +1,222 @@
+"""The structured event plane: ONE emitter behind every ``ROKO_*`` line.
+
+Five subsystems grew five independently invented stderr formats
+(``ROKO_GUARD`` / ``ROKO_WATCHDOG`` / ``ROKO_FAILOVER`` /
+``ROKO_ROLLOUT`` plus the supervisor's fleet prose). This module is the
+single place those formats live now:
+
+- :func:`format_line` renders the legacy grep-stable one-liner
+  (``ROKO_<SUBSYSTEM> event=<event> k=v ...``) byte-compatibly — float
+  compaction and key order follow the call site, exactly as
+  ``training/guard.py:guard_line`` always did;
+- :func:`emit` writes that line through the caller's ``log`` (stderr by
+  default) AND appends one JSON record to the optional event-log sink
+  (``--event-log PATH`` on the serve/polish/train CLIs,
+  ``ServeConfig.event_log`` / ``GuardConfig.event_log``), so the same
+  event is greppable in a terminal and queryable as data;
+- the sink (:class:`EventLog`) is JSONL with size-capped rotation
+  (``<path>`` -> ``<path>.1``), fsync-free append — events are
+  diagnostics, not a journal; losing the tail on a power cut is fine.
+
+A tier-1 guard test (``tests/test_obs.py``) greps the package for bare
+``ROKO_*`` event literals outside ``obs/`` so a new subsystem can't
+fork the format again.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+Log = Callable[[str], None]
+
+#: event subsystems with a reserved legacy stderr prefix (``ROKO_<X>``).
+#: Every one-line event format in the codebase routes through here.
+SUBSYSTEMS = (
+    "guard", "watchdog", "failover", "rollout", "fleet", "serve", "trace",
+)
+
+
+def legacy_prefix(subsystem: str) -> str:
+    """The grep prefix of ``subsystem``'s legacy one-liners
+    (``guard`` -> ``ROKO_GUARD``)."""
+    return "ROKO_" + subsystem.upper()
+
+
+def _fmt_value(v: Any) -> str:
+    # the guard_line float compaction, applied plane-wide: floats render
+    # %.6g so thresholds and losses stay short and grep-stable
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    return str(v)
+
+
+def format_line(
+    subsystem: str,
+    event: str,
+    fields: Optional[Dict[str, Any]] = None,
+    *,
+    suffix: str = "",
+    bare_event: bool = False,
+    text: Optional[str] = None,
+) -> str:
+    """The legacy one-liner: ``ROKO_<SUB> event=<event> k=v ... <suffix>``.
+
+    ``bare_event`` drops the ``event=`` key (the watchdog's historical
+    ``ROKO_WATCHDOG hang stage=...`` shape); ``text`` replaces
+    everything after the prefix verbatim (the failover prose line).
+    Key order follows the fields dict (call-site order)."""
+    prefix = legacy_prefix(subsystem)
+    if text is not None:
+        return f"{prefix} {text}"
+    parts = [prefix, event if bare_event else f"event={event}"]
+    for k, v in (fields or {}).items():
+        parts.append(f"{k}={_fmt_value(v)}")
+    if suffix:
+        parts.append(suffix)
+    return " ".join(parts)
+
+
+class EventLog:
+    """Append-only JSONL sink with size-capped rotation: when the file
+    passes ``max_bytes`` it is renamed to ``<path>.1`` (replacing any
+    previous rotation) and a fresh file started — bounded disk for a
+    long-lived service, and at least one full cap of history retained."""
+
+    def __init__(self, path: str, max_bytes: int = 64 * 2**20):
+        self.path = path
+        self.max_bytes = max(1, int(max_bytes))
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        # append: a restarted service continues the same log
+        self._f = open(path, "a", encoding="utf-8")
+
+    def write(self, record: Dict[str, Any]) -> None:
+        line = json.dumps(record, default=str, separators=(",", ":"))
+        with self._lock:
+            if self._f is None:
+                return  # sink died (disk full / dir vanished): stay dead
+            try:
+                if self._f.tell() + len(line) + 1 > self.max_bytes:
+                    self._rotate()
+                self._f.write(line + "\n")
+                self._f.flush()
+            except (OSError, ValueError):
+                # diagnostics must never take the service down with
+                # them; ValueError = a write raced a failed rotation's
+                # closed handle. Mark the sink dead rather than raising
+                # out of emit() on every later event.
+                if self._f is not None:
+                    try:
+                        self._f.close()
+                    except (OSError, ValueError):
+                        pass
+                self._f = None
+
+    def _rotate(self) -> None:
+        try:
+            os.replace(self.path, self.path + ".1")
+        except OSError:
+            # rename failed (``.1`` is a directory, EPERM mount): KEEP
+            # the existing history — the live handle stays valid and
+            # the file grows past the cap (retried on the next write)
+            # rather than truncating the only copy
+            return
+        self._f.close()
+        try:
+            self._f = open(self.path, "w", encoding="utf-8")
+        except OSError:
+            # reopen failed (dir gone, quota): dead sink, not a crash —
+            # write() guards on None from here on
+            self._f = None
+            raise
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                if self._f is not None:
+                    self._f.close()
+            except (OSError, ValueError):
+                pass
+            self._f = None
+
+
+#: process-global sink (None = events go to stderr/log only). One per
+#: process is right: fleet workers are separate processes and the CLI
+#: suffixes the path per worker id so rotation never races.
+_sink: Optional[EventLog] = None
+
+
+def configure_event_log(
+    path: Optional[str], max_mb: float = 64.0
+) -> Optional[str]:
+    """Install (or, with ``path=None``, remove) the process-global JSONL
+    sink. Returns the configured path. Called once at CLI start; safe to
+    call again (the previous sink is closed)."""
+    global _sink
+    if _sink is not None:
+        _sink.close()
+        _sink = None
+    if path:
+        _sink = EventLog(path, max_bytes=int(max_mb * 2**20))
+    return path
+
+
+def event_log_path() -> Optional[str]:
+    """The live sink's path (None when no ``--event-log`` is set)."""
+    return _sink.path if _sink is not None else None
+
+
+def _stderr(line: str) -> None:
+    print(line, file=sys.stderr, flush=True)
+
+
+def emit(
+    subsystem: str,
+    event: str,
+    *,
+    request_id: Optional[str] = None,
+    log: Optional[Log] = None,
+    suffix: str = "",
+    bare_event: bool = False,
+    text: Optional[str] = None,
+    quiet: bool = False,
+    **fields: Any,
+) -> str:
+    """Emit one event: the legacy one-liner through ``log`` (stderr by
+    default) plus a JSON record to the configured sink. Returns the
+    rendered line.
+
+    ``quiet=True`` skips the line entirely (sink-only) — for
+    per-request plumbing events (fleet dispatch spans) that would spam
+    stderr on the hot path; without a sink configured a quiet emit is
+    free."""
+    sink = _sink
+    if quiet and sink is None:
+        return ""  # nothing would be written; skip the formatting too
+    line = format_line(
+        subsystem, event, fields,
+        suffix=suffix, bare_event=bare_event, text=text,
+    )
+    if not quiet:
+        (log or _stderr)(line)
+    if sink is not None:
+        record: Dict[str, Any] = {
+            "ts": round(time.time(), 6),
+            "subsystem": subsystem,
+            "event": event,
+        }
+        if request_id is not None:
+            record["request_id"] = request_id
+        record.update(fields)
+        if suffix:
+            record["note"] = suffix
+        if text is not None:
+            record["text"] = text
+        sink.write(record)
+    return line

@@ -28,6 +28,8 @@ import threading
 import traceback
 from typing import Any, Callable, Optional
 
+from roko_tpu.obs import events as obs_events
+
 Log = Callable[[str], None]
 
 
@@ -76,10 +78,16 @@ def thread_stack(thread: threading.Thread) -> str:
 
 
 def hang_diagnostic(stage: str, deadline_s: float) -> str:
-    """The one-line machine-parseable hang record (ROKO_WATCHDOG ...)."""
-    return (
-        f"ROKO_WATCHDOG hang stage={stage} deadline_s={deadline_s:g} "
-        f"threads={threading.active_count()}"
+    """The one-line machine-parseable hang record (``ROKO_WATCHDOG hang
+    stage=... deadline_s=... threads=...`` — the historical bare-event
+    shape, formatted by the shared event plane)."""
+    return obs_events.format_line(
+        "watchdog", "hang", {
+            "stage": stage,
+            "deadline_s": deadline_s,
+            "threads": threading.active_count(),
+        },
+        bare_event=True,
     )
 
 
@@ -164,7 +172,14 @@ def call_with_deadline(
     )
     t.start()
     if not done.wait(deadline_s):
-        log(hang_diagnostic(stage, deadline_s))
+        # the one-liner goes through the event plane so a configured
+        # --event-log sink records the hang as data too; the full stack
+        # dump stays log-only (it is a post-mortem blob, not an event)
+        obs_events.emit(
+            "watchdog", "hang", log=log, bare_event=True,
+            stage=stage, deadline_s=deadline_s,
+            threads=threading.active_count(),
+        )
         log(dump_thread_stacks(skip_current=True))
         raise HangError(stage, deadline_s)
     if "error" in box:

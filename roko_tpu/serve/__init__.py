@@ -20,7 +20,10 @@ Modules:
 - ``metrics``  — Prometheus-style text counters over
   :class:`roko_tpu.utils.profiling.StageTimer`
 - ``server``   — ``ThreadingHTTPServer`` front end
-  (``POST /polish``, ``GET /healthz``, ``GET /metrics``)
+  (``POST /polish``, ``GET /healthz``, ``GET /metrics``, plus the
+  observability surfaces ``GET /tracez`` and ``POST /profilez`` —
+  request tracing, mergeable histograms, and the structured event
+  plane live in :mod:`roko_tpu.obs`, docs/OBSERVABILITY.md)
 - ``client``   — stdlib urllib client used by tests and ``tools/``
 - ``fleet``    — multi-worker tier: process supervision (heartbeats,
   restart backoff, restart-storm breaker) + failover routing

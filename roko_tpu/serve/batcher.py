@@ -46,14 +46,17 @@ class Backpressure(Exception):
 
 
 class _Request:
-    __slots__ = ("x", "done", "preds", "error", "t_submit")
+    __slots__ = ("x", "done", "preds", "error", "t_submit", "trace")
 
-    def __init__(self, x: np.ndarray):
+    def __init__(self, x: np.ndarray, trace=None):
         self.x = x
         self.done = threading.Event()
         self.preds: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        #: optional per-request obs.trace.RequestTrace riding the
+        #: request through the batching plane (docs/OBSERVABILITY.md)
+        self.trace = trace
 
 
 class PredictFuture:
@@ -122,6 +125,7 @@ class MicroBatcher:
         self._running = False
         self._stopped = False  # set once by stop(); submissions then fail fast
         self._thread: Optional[threading.Thread] = None
+        self._steps = 0  # device dispatches so far (trace step ids)
         if metrics is not None:
             metrics.queue_depth = self._q.qsize
         if start:
@@ -163,11 +167,14 @@ class MicroBatcher:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, x: np.ndarray) -> PredictFuture:
+    def submit(self, x: np.ndarray, trace=None) -> PredictFuture:
         """Enqueue one window batch; raises :class:`Backpressure` when
         the queue is full and ``RuntimeError`` once the batcher has been
         stopped (a dead worker must fail requests fast, not strand
-        their futures)."""
+        their futures). ``trace`` (a
+        :class:`roko_tpu.obs.trace.RequestTrace`) collects the
+        queue-wait / device span breakdown for the reply's ``timings``
+        field."""
         if self._stopped:
             raise RuntimeError("batcher stopped")
         if self.breaker is not None and not self.breaker.allow():
@@ -180,7 +187,7 @@ class MicroBatcher:
                 max(self.breaker.retry_after_s(), self.retry_after_s),
                 reason="circuit breaker open (device failing)",
             )
-        req = _Request(np.ascontiguousarray(x, dtype=np.uint8))
+        req = _Request(np.ascontiguousarray(x, dtype=np.uint8), trace)
         try:
             self._q.put_nowait(req)
         except queue.Full:
@@ -202,10 +209,21 @@ class MicroBatcher:
         return PredictFuture(req, self.metrics)
 
     def predict(
-        self, x: np.ndarray, timeout: Optional[float] = None
+        self, x: np.ndarray, timeout: Optional[float] = None, trace=None
     ) -> np.ndarray:
         """submit + result in one call (the HTTP handler's path)."""
-        return self.submit(x).result(timeout)
+        return self.submit(x, trace=trace).result(timeout)
+
+    def snapshot(self) -> dict:
+        """The ``GET /tracez`` scheduler block, deadline-mode edition:
+        whole requests queue here, so the view is just depth + steps
+        (the continuous scheduler reports the richer slot-pool state)."""
+        return {
+            "mode": self.BATCHING_MODE,
+            "queue_depth": self._q.qsize(),
+            "steps": self._steps,
+            "ladder": list(self.session.ladder),
+        }
 
     # -- worker side --------------------------------------------------------
 
@@ -257,12 +275,23 @@ class MicroBatcher:
         """Predict one coalesced batch and scatter results back."""
         sizes = [len(r.x) for r in batch]
         total = sum(sizes)
+        now = time.perf_counter()
+        for r in batch:
+            # queue-wait: submit until this dispatch formed (the
+            # deadline coalescer packs a whole request at once)
+            wait = now - r.t_submit
+            if r.trace is not None:
+                r.trace.add("queue_wait", wait)
+            if self.metrics is not None:
+                self.metrics.hist_queue_wait.observe(wait)
         try:
+            t_pack = time.perf_counter()
             x = (
                 batch[0].x
                 if len(batch) == 1
                 else np.concatenate([r.x for r in batch])
             )
+            t_dev = time.perf_counter()
             preds = self.session.predict(x)
         except BaseException as e:  # propagate to every waiter
             if self.breaker is not None:
@@ -284,16 +313,26 @@ class MicroBatcher:
             return
         if self.breaker is not None:
             self.breaker.record_success()
+        dt_dev = time.perf_counter() - t_dev
+        self._steps += 1
+        if self.metrics is not None:
+            self.metrics.hist_device.observe(dt_dev)
+        padded = max(1, self.session.padded_size(total))
+        dp = getattr(self.session, "dp", 1)
         off = 0
         for r, n in zip(batch, sizes):
+            if r.trace is not None:
+                r.trace.add("pack", t_dev - t_pack)
+                r.trace.add_step(
+                    dt_dev, rung=padded, step=self._steps,
+                    occupancy=total / padded, dp=dp, windows=n,
+                )
             r.preds = preds[off : off + n]
             off += n
             r.done.set()
         if self.metrics is not None:
             self.metrics.inc("batches")
-            self.metrics.observe_fill(
-                total, max(1, self.session.padded_size(total))
-            )
+            self.metrics.observe_fill(total, padded)
 
     def _loop(self) -> None:
         while self._running:

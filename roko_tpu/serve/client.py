@@ -74,14 +74,17 @@ class PolishClient:
     # -- transport ----------------------------------------------------------
 
     def _request(
-        self, path: str, payload: Optional[Dict[str, Any]] = None
+        self, path: str, payload: Optional[Dict[str, Any]] = None,
+        headers: Optional[Dict[str, str]] = None,
     ) -> Any:
         url = self.base_url + path
         data = None if payload is None else json.dumps(payload).encode()
+        hdrs = {"Content-Type": "application/json"} if data else {}
+        hdrs.update(headers or {})
         req = urllib.request.Request(
             url,
             data=data,
-            headers={"Content-Type": "application/json"} if data else {},
+            headers=hdrs,
             method="POST" if data else "GET",
         )
         try:
@@ -110,8 +113,15 @@ class PolishClient:
     def metrics(self) -> str:
         return self._request("/metrics").decode()
 
+    def tracez(self, last: Optional[int] = None) -> Dict[str, Any]:
+        """The trace ring + scheduler snapshot (docs/OBSERVABILITY.md);
+        against a fleet front end the body is keyed by worker id."""
+        path = "/tracez" + (f"?last={int(last)}" if last else "")
+        return json.loads(self._request(path))
+
     def _post_with_retries(
-        self, payload: Dict[str, Any], retries: int
+        self, payload: Dict[str, Any], retries: int,
+        request_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         """POST /polish, sleeping through up to ``retries``
         :class:`ServerBusy` replies (503: queue full, breaker open, or
@@ -125,10 +135,19 @@ class PolishClient:
         policy = dataclasses.replace(
             self.retry_policy, max_attempts=retries + 1
         )
+        # the 2-arg call stays the default so _request stand-ins (tests)
+        # keep working; the header rides only when an id is pinned
+        headers = (
+            {"X-Roko-Request-Id": request_id} if request_id else None
+        )
         try:
             return json.loads(
                 policy.call(
-                    lambda: self._request("/polish", payload),
+                    lambda: (
+                        self._request("/polish", payload, headers)
+                        if headers
+                        else self._request("/polish", payload)
+                    ),
                     retry_after=lambda e: getattr(e, "retry_after_s", None),
                     sleep=self._sleep,
                 )
@@ -145,11 +164,14 @@ class PolishClient:
         examples: np.ndarray,
         contig: str = "seq",
         retries: int = 4,
+        request_id: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Polish one contig from pre-extracted windows. ``retries``
         bounds how many :class:`ServerBusy` replies are slept through
         (honouring the server's retry-after as a backoff floor) before
-        giving up; 0 surfaces the first busy reply."""
+        giving up; 0 surfaces the first busy reply. ``request_id`` pins
+        the trace identity (``X-Roko-Request-Id``) — by default the
+        service mints one and returns it in the reply."""
         examples = np.asarray(examples)
         payload = {
             "contig": contig,
@@ -158,7 +180,7 @@ class PolishClient:
             "positions": _b64(positions, np.int64),
             "examples": _b64(examples, np.uint8),
         }
-        return self._post_with_retries(payload, retries)
+        return self._post_with_retries(payload, retries, request_id)
 
     def polish_bam(
         self, ref: str, bam: str, workers: int = 1, seed: int = 0,

@@ -55,8 +55,10 @@ import urllib.request
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from roko_tpu.config import FleetConfig, RokoConfig
+from roko_tpu.obs import events as obs_events
+from roko_tpu.obs.hist import merge_histogram_rows, parse_histogram_rows, render_histogram_rows
 from roko_tpu.resilience import CircuitBreaker, RetryPolicy
-from roko_tpu.serve.metrics import parse_metric_values
+from roko_tpu.serve.metrics import HISTOGRAM_SERIES, parse_metric_values
 
 # worker lifecycle states (rendered in /healthz and the
 # roko_fleet_worker_state gauge)
@@ -673,7 +675,10 @@ class Fleet:
             return w, w.port
 
     def post_polish(
-        self, body: bytes, timeout: Optional[float] = None
+        self,
+        body: bytes,
+        timeout: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> Tuple[int, bytes, Dict[str, str]]:
         """Route one ``POST /polish`` body to a ready worker with
         transparent failover: a connection-level failure (worker died
@@ -681,7 +686,13 @@ class Fleet:
         idempotent, so the client sees added latency, never the crash.
         Worker 503s try the next worker, then surface as a fleet 503
         with the largest ``Retry-After`` observed. Returns
-        ``(status, reply_body, extra_headers)``."""
+        ``(status, reply_body, extra_headers)``.
+
+        ``request_id`` (assigned by the front end) rides every dispatch
+        as ``X-Roko-Request-Id`` — including the failover RE-dispatch,
+        so the worker trace and event log see ONE request however many
+        workers it visited; each dispatch appends a quiet ``fleet
+        dispatch`` event to the configured event log."""
         cfg = self.fleet_cfg
         tried: List[int] = []
         # resolved lazily: the live hint sweeps every worker's waitpid
@@ -693,8 +704,19 @@ class Fleet:
                 break
             w, port = picked
             tried.append(w.id)
+            if request_id is not None:
+                # sink-only (quiet): one record per dispatch attempt —
+                # after a mid-request SIGKILL the log shows the SAME
+                # request_id with two dispatch spans on two workers
+                obs_events.emit(
+                    "fleet", "dispatch", quiet=True,
+                    request_id=request_id, worker=w.id,
+                    attempt=len(tried),
+                )
             try:
-                code, reply, hdrs = self._forward(port, body, timeout)
+                code, reply, hdrs = self._forward(
+                    port, body, timeout, request_id=request_id
+                )
             except _CONN_ERRORS as e:
                 # the worker vanished mid-request: suspect it (the
                 # supervision loop confirms via waitpid/heartbeat and
@@ -704,6 +726,12 @@ class Fleet:
                     f"roko fleet: worker {w.id} dropped a request "
                     f"({type(e).__name__}); failing over"
                 )
+                if request_id is not None:
+                    obs_events.emit(
+                        "fleet", "failover", quiet=True,
+                        request_id=request_id, worker=w.id,
+                        error=type(e).__name__,
+                    )
                 with self._lock:
                     if w.state == READY:
                         w.state = UNHEALTHY
@@ -728,7 +756,11 @@ class Fleet:
         return 503, body_out, {"Retry-After": f"{max(1, round(retry_after))}"}
 
     def _forward(
-        self, port: int, body: bytes, timeout: Optional[float] = None
+        self,
+        port: int,
+        body: bytes,
+        timeout: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> Tuple[int, bytes, Dict[str, str]]:
         """One POST /polish to one worker's snapshotted port, no
         retries here. The default read timeout is generous (a polish
@@ -741,11 +773,11 @@ class Fleet:
             "127.0.0.1", port,
             timeout=REQUEST_TIMEOUT_S if timeout is None else timeout,
         )
+        headers = {"Content-Type": "application/json"}
+        if request_id is not None:
+            headers["X-Roko-Request-Id"] = request_id
         try:
-            conn.request(
-                "POST", "/polish", body=body,
-                headers={"Content-Type": "application/json"},
-            )
+            conn.request("POST", "/polish", body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             return resp.status, data, dict(resp.getheaders())
@@ -846,6 +878,7 @@ class Fleet:
         )
         names = tuple(n for n, _ in PASSTHROUGH_SERIES)
         scraped: Dict[int, Dict[str, str]] = {}
+        bodies: Dict[int, str] = {}
         for w in self.workers:
             if w.port is None or not w.alive():
                 continue
@@ -854,9 +887,8 @@ class Fleet:
                 with urllib.request.urlopen(
                     url, timeout=self.fleet_cfg.heartbeat_timeout_s
                 ) as r:
-                    scraped[w.id] = parse_metric_values(
-                        r.read().decode(), names
-                    )
+                    bodies[w.id] = body = r.read().decode()
+                    scraped[w.id] = parse_metric_values(body, names)
             except _CONN_ERRORS:  # URLError subclasses OSError
                 continue
         for name, kind in PASSTHROUGH_SERIES:
@@ -870,4 +902,40 @@ class Fleet:
             lines.append(f"# TYPE {name} {kind}")
             for wid, val in rows:
                 lines.append(f'{name}{{worker="{wid}"}} {val}')
+        # MERGEABLE histograms (docs/OBSERVABILITY.md): fleet-level rows
+        # are the bucket-wise SUM of the worker rows — sound because
+        # every process shares DEFAULT_LATENCY_BUCKETS — so a fleet p99
+        # derives from the summed CDF instead of a percentile
+        # passthrough that cannot aggregate; the per-worker rows stay
+        # beside them labeled worker="i"
+        for name in HISTOGRAM_SERIES:
+            per_worker = {
+                wid: parse_histogram_rows(body, name)
+                for wid, body in sorted(bodies.items())
+            }
+            merged = merge_histogram_rows(per_worker.values())
+            if not merged:
+                continue
+            lines.append(f"# TYPE {name} histogram")
+            lines.extend(render_histogram_rows(name, merged))
+            for wid, rows in per_worker.items():
+                lines.extend(
+                    render_histogram_rows(name, rows, extra=f'worker="{wid}"')
+                )
         return "\n".join(lines) + "\n"
+
+    def tracez(self, query: str = "") -> Dict[str, object]:
+        """The supervisor ``GET /tracez`` body: every live worker's
+        trace ring + scheduler snapshot, keyed by worker id (a worker
+        not answering is simply absent)."""
+        out: Dict[str, object] = {}
+        path = "/tracez" + (f"?{query}" if query else "")
+        for w in self.workers:
+            if w.port is None or not w.alive():
+                continue
+            try:
+                _, body = self._probe(w, path)
+                out[str(w.id)] = body
+            except _CONN_ERRORS:
+                continue
+        return {"workers": out}

@@ -28,7 +28,16 @@ Exposed series (all prefixed ``roko_serve_``):
   size-class rows labeled ``size_class="le{rung}"`` (the ladder rung
   the request's window count buckets into; ``gt{top}`` past the top
   rung) once ``size_classes`` is set — small-request p99 beside
-  large-request p99 is the head-of-line-blocking signal;
+  large-request p99 is the head-of-line-blocking signal. Summaries are
+  PER-WORKER-ONLY: percentiles do not merge across processes;
+- three MERGEABLE cumulative histograms WITHOUT the serve prefix
+  (fleet-level names — the supervisor aggregates them by bucket-sum,
+  docs/OBSERVABILITY.md): ``roko_request_latency_seconds_bucket{le=,
+  size_class=}`` (+ ``_sum``/``_count``) over the same spans the
+  summary sees, and the request-time decomposition
+  ``roko_queue_wait_seconds`` (submit -> first pack) and
+  ``roko_device_time_seconds`` (one device step), fixed bounds from
+  :data:`roko_tpu.obs.hist.DEFAULT_LATENCY_BUCKETS`;
 - ``breaker_state`` — gauge, 0 closed / 1 half-open / 2 open — and
   ``breaker_trips_total`` — counter — when a
   :class:`roko_tpu.resilience.CircuitBreaker` is attached
@@ -49,10 +58,19 @@ import threading
 from typing import Callable, Dict, Optional, Tuple
 
 from roko_tpu.compile.cache import cache_counters
+from roko_tpu.obs.hist import HistogramFamily
 from roko_tpu.utils.profiling import StageTimer
 
 _PREFIX = "roko_serve_"
 _COUNTERS = ("requests", "windows", "batches", "rejected", "errors")
+
+#: the mergeable histogram families every worker renders (and the fleet
+#: supervisor bucket-sums into fleet-level rows — serve/fleet.py)
+HISTOGRAM_SERIES = (
+    "roko_request_latency_seconds",
+    "roko_queue_wait_seconds",
+    "roko_device_time_seconds",
+)
 
 
 def parse_metric_values(text: str, names) -> Dict[str, str]:
@@ -97,6 +115,15 @@ class ServeMetrics:
         #: deadline mode, the series are simply absent)
         self.queue_windows: Optional[Callable[[], int]] = None
         self.occupancy: Optional[Callable[[], float]] = None
+        #: mergeable cumulative histograms (fixed shared buckets, so the
+        #: fleet supervisor can SUM worker rows — docs/OBSERVABILITY.md):
+        #: request latency by size class, plus the queue-wait /
+        #: device-time decomposition both batching policies feed
+        self.hist_latency = HistogramFamily(
+            "roko_request_latency_seconds", label="size_class"
+        )
+        self.hist_queue_wait = HistogramFamily("roko_queue_wait_seconds")
+        self.hist_device = HistogramFamily("roko_device_time_seconds")
 
     def size_class(self, windows: int) -> str:
         """Ladder-rung bucket label for an n-window request: ``le{r}``
@@ -112,8 +139,12 @@ class ServeMetrics:
         batching modes, so the per-class p50/p99 comparison is
         apples-to-apples)."""
         self.timer.record("request", seconds)
-        if self.size_classes:
-            self.timer.record(f"request:{self.size_class(windows)}", seconds)
+        label = self.size_class(windows) if self.size_classes else None
+        if label is not None:
+            self.timer.record(f"request:{label}", seconds)
+        # the histogram sees every request the summary sees, so a
+        # bucket-derived fleet p99 is consistent with per-worker data
+        self.hist_latency.observe(seconds, label)
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -228,4 +259,9 @@ class ServeMetrics:
                     f'{lat}_sum{{size_class="{label}"}} '
                     f"{self.timer.totals.get(stage, 0.0):.6f}"
                 )
+        # mergeable histograms last (fleet-level names, no serve prefix:
+        # the supervisor bucket-sums these across workers)
+        for hist in (self.hist_latency, self.hist_queue_wait,
+                     self.hist_device):
+            lines.extend(hist.render())
         return "\n".join(lines) + "\n"

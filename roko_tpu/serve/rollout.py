@@ -41,6 +41,7 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
+from roko_tpu.obs import events as obs_events
 from roko_tpu.resilience.journal import _fsync_write
 from roko_tpu.serve.fleet import (
     BOOT_VERSION,
@@ -104,10 +105,10 @@ class _StateFile:
             return None
         except (OSError, ValueError) as e:
             if log is not None:
-                log(
-                    f"ROKO_ROLLOUT event={self.UNREADABLE_EVENT} "
-                    f"path={self.path} error={e!r} "
-                    f"action={self.UNREADABLE_ACTION}"
+                obs_events.emit(
+                    "rollout", self.UNREADABLE_EVENT, log=log,
+                    path=self.path, error=repr(e),
+                    action=self.UNREADABLE_ACTION,
                 )
             return None
 
@@ -175,12 +176,13 @@ def recover_rollout(
         action = "revert"
     frm = rec.get("from", {}) or {}
     to = rec.get("to", {}) or {}
-    log(
-        f"ROKO_ROLLOUT event=recovered state={rec.get('state')} "
-        f"from={frm.get('version')} to={to.get('version')} "
-        f"done={done}/{n} action={action} — an interrupted rollout was "
-        "found; the fleet will boot uniformly on "
-        f"{(to if action == 'finalize' else frm).get('version')!r}"
+    obs_events.emit(
+        "rollout", "recovered", log=log,
+        suffix="— an interrupted rollout was found; the fleet will boot "
+        f"uniformly on {(to if action == 'finalize' else frm).get('version')!r}",
+        state=rec.get("state"),
+        **{"from": frm.get("version"), "to": to.get("version")},
+        done=f"{done}/{n}", action=action,
     )
     return {"action": action, "record": rec}
 
@@ -406,12 +408,18 @@ class RolloutController:
         self.state = "rolling"
         hb = self.fleet.fleet_cfg.heartbeat_timeout_s
         self.baseline = capture_baseline(self.fleet, hb)
-        self._log(
-            f"ROKO_ROLLOUT event=start from={self.from_version} "
-            f"to={self.to_version} workers={len(self.fleet.workers)} "
-            f"bake_s={self.bake_s:g} "
-            f"baseline_error_pct={self.baseline.error_pct:.3f} "
-            f"baseline_p99_s={self.baseline.p99_s if self.baseline.p99_s is not None else 'n/a'}"
+        obs_events.emit(
+            "rollout", "start", log=self._log,
+            **{"from": self.from_version, "to": self.to_version},
+            workers=len(self.fleet.workers),
+            bake_s=f"{self.bake_s:g}",
+            baseline_error_pct=f"{self.baseline.error_pct:.3f}",
+            # pre-stringified: str(float) keeps the historical full
+            # repr; emit's %.6g compaction would alter the bytes
+            baseline_p99_s=(
+                str(self.baseline.p99_s)
+                if self.baseline.p99_s is not None else "n/a"
+            ),
         )
         self.journal.write(self._record("rolling"))
         try:
@@ -422,10 +430,10 @@ class RolloutController:
                     return
                 self.done.append(w.id)
                 self.journal.write(self._record("rolling"))
-                self._log(
-                    f"ROKO_ROLLOUT event=worker_done worker={w.id} "
-                    f"version={self.to_version} "
-                    f"done={len(self.done)}/{len(self.fleet.workers)}"
+                obs_events.emit(
+                    "rollout", "worker_done", log=self._log,
+                    worker=w.id, version=self.to_version,
+                    done=f"{len(self.done)}/{len(self.fleet.workers)}",
                 )
             with self.fleet._lock:
                 self.fleet.active_version = self.to_version
@@ -437,9 +445,9 @@ class RolloutController:
             # silent revert to the CLI incumbent
             self.current.write(self._side(self.to_version))
             self.journal.delete()
-            self._log(
-                f"ROKO_ROLLOUT event=done version={self.to_version} "
-                f"workers={len(self.done)}"
+            obs_events.emit(
+                "rollout", "done", log=self._log,
+                version=self.to_version, workers=len(self.done),
             )
         except Exception as e:  # defensive: never leave state unjournaled
             self._rollback(f"internal rollout error: {e!r}")
@@ -464,9 +472,9 @@ class RolloutController:
         """Drain-restart one worker onto ``version`` and wait it back
         to READY; with ``gate`` also hold the bake window and judge the
         canary. Returns None on success, else the rollback reason."""
-        self._log(
-            f"ROKO_ROLLOUT event=roll worker={w.id} from={w.version} "
-            f"to={version}"
+        obs_events.emit(
+            "rollout", "roll", log=self._log,
+            worker=w.id, **{"from": w.version, "to": version},
         )
         try:
             self.fleet.roll_worker(w, version)
@@ -537,9 +545,10 @@ class RolloutController:
         it can observe, it does not manufacture them."""
         base = self.baseline or Baseline(0.0, None, 0)
         if start is None or end is None:
-            self._log(
-                f"ROKO_ROLLOUT event=gate worker={w.id} verdict=pass "
-                "detail=metrics_unscrapeable (health gate only)"
+            obs_events.emit(
+                "rollout", "gate", log=self._log,
+                suffix="(health gate only)",
+                worker=w.id, verdict="pass", detail="metrics_unscrapeable",
             )
             return None
         d_req = max(0, end.requests - start.requests)
@@ -566,10 +575,10 @@ class RolloutController:
                 f"rollback_p99_x={self.rollback_p99_x:g} x baseline "
                 f"{base.p99_s * 1e3:.1f}ms"
             )
-        self._log(
-            f"ROKO_ROLLOUT event=gate worker={w.id} verdict=pass "
-            f"requests={d_req} errors={d_err} "
-            f"p99_s={end.p99_s if end.p99_s is not None else 'n/a'}"
+        obs_events.emit(
+            "rollout", "gate", log=self._log,
+            worker=w.id, verdict="pass", requests=d_req, errors=d_err,
+            p99_s=str(end.p99_s) if end.p99_s is not None else "n/a",
         )
         return None
 
@@ -578,9 +587,10 @@ class RolloutController:
     def _rollback(self, reason: str) -> None:
         self.state = "rolling_back"
         self.reason = reason
-        self._log(
-            f"ROKO_ROLLOUT event=rollback from={self.to_version} "
-            f"to={self.from_version} reason={reason!r}"
+        obs_events.emit(
+            "rollout", "rollback", log=self._log,
+            **{"from": self.to_version, "to": self.from_version},
+            reason=repr(reason),
         )
         self.journal.write(self._record("rolling_back"))
         for w in self.fleet.workers:
@@ -592,9 +602,9 @@ class RolloutController:
                 # the fleet is going down anyway; the journal survives
                 # and the next start reverts the rest
                 self.state = "failed"
-                self._log(
-                    "ROKO_ROLLOUT event=rollback_interrupted "
-                    "reason=fleet_draining (journal kept)"
+                obs_events.emit(
+                    "rollout", "rollback_interrupted", log=self._log,
+                    suffix="(journal kept)", reason="fleet_draining",
                 )
                 return
             why = self._roll_one(w, self.from_version, gate=False)
@@ -605,10 +615,11 @@ class RolloutController:
                 self.state = "failed"
                 self.finished_unix = _now_unix()
                 self.journal.write(self._record("rolling_back"))
-                self._log(
-                    f"ROKO_ROLLOUT event=rollback_failed worker={w.id} "
-                    f"reason={why!r} — fleet left degraded, journal "
-                    f"kept at {self.journal.path}"
+                obs_events.emit(
+                    "rollout", "rollback_failed", log=self._log,
+                    suffix="— fleet left degraded, journal kept at "
+                    f"{self.journal.path}",
+                    worker=w.id, reason=repr(why),
                 )
                 return
         self.state = "rolled_back"
@@ -620,7 +631,8 @@ class RolloutController:
         else:
             self.current.write(self._side(self.from_version))
         self.journal.delete()
-        self._log(
-            f"ROKO_ROLLOUT event=rolled_back version={self.from_version} "
-            f"— incumbent restored on every worker"
+        obs_events.emit(
+            "rollout", "rolled_back", log=self._log,
+            suffix="— incumbent restored on every worker",
+            version=self.from_version,
         )

@@ -57,7 +57,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional, Tuple
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -88,9 +89,12 @@ class _Slot:
     many steps); ``filled`` as their predictions scatter back. The
     future resolves when every window is filled."""
 
-    __slots__ = ("x", "preds", "next", "filled", "done", "error", "t_submit")
+    __slots__ = (
+        "x", "preds", "next", "filled", "done", "error", "t_submit",
+        "trace",
+    )
 
-    def __init__(self, x: np.ndarray):
+    def __init__(self, x: np.ndarray, trace=None):
         self.x = x
         self.preds = np.empty((x.shape[0], x.shape[2]), np.int32)
         self.next = 0       # windows handed to a device step so far
@@ -98,6 +102,9 @@ class _Slot:
         self.done = threading.Event()
         self.error: Optional[BaseException] = None
         self.t_submit = time.perf_counter()
+        #: optional per-request obs.trace.RequestTrace (queue-wait /
+        #: pack / device-step / scatter spans — docs/OBSERVABILITY.md)
+        self.trace = trace
 
     @property
     def n(self) -> int:
@@ -163,6 +170,14 @@ class ContinuousBatcher:
         #: reusable top-rung slot slab: spans copy into it densely each
         #: step, so steady state allocates nothing per dispatch
         self._slab: Optional[np.ndarray] = None
+        #: device steps dispatched so far (trace step ids) and the
+        #: bounded rung history the /tracez scheduler snapshot serves
+        self._steps = 0
+        self._rung_history: deque = deque(maxlen=64)
+        #: live requests (submitted, not yet complete) keyed by id() —
+        #: the /tracez in-flight segment view; removal on completion,
+        #: error, and stop keeps it bounded
+        self._live: Dict[int, _Slot] = {}
         # derived from config, not the session's private attribute, so
         # session stand-ins (tests, tools) need only carry a cfg
         w = session.cfg.model
@@ -190,6 +205,40 @@ class ContinuousBatcher:
         ``roko_serve_scheduler_occupancy`` gauge; >1 means the next
         step is already oversubscribed)."""
         return self.backlog_windows() / self.session.ladder[-1]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The live scheduler state ``GET /tracez`` serves beside the
+        trace ring (docs/OBSERVABILITY.md): queued-window backlog,
+        in-flight request segments (windows packed vs filled per live
+        request), the observed throughput EMA, and the bounded
+        rung-dispatch history."""
+        with self._cv:
+            live = list(self._live.values())
+            backlog = sum(s.n - s.next for s in self._pool)
+            history = list(self._rung_history)
+            ema = self._ema_wps
+            steps = self._steps
+        return {
+            "mode": self.BATCHING_MODE,
+            "backlog_windows": backlog,
+            "occupancy": round(backlog / self.session.ladder[-1], 4),
+            "steps": steps,
+            "ema_windows_per_s": round(ema, 2) if ema else None,
+            "ladder": list(self.session.ladder),
+            "in_flight": [
+                {
+                    "request_id": (
+                        s.trace.request_id if s.trace is not None else None
+                    ),
+                    "windows": s.n,
+                    "packed": s.next,
+                    "filled": s.filled,
+                    "age_s": round(time.perf_counter() - s.t_submit, 4),
+                }
+                for s in live
+            ],
+            "rung_history": history,
+        }
 
     @property
     def retry_after_s(self) -> float:
@@ -247,14 +296,15 @@ class ContinuousBatcher:
     def _fail_incomplete(self) -> None:
         with self._cv:
             pool, self._pool = self._pool, []
-        for slot in pool:
+            live, self._live = list(self._live.values()), {}
+        for slot in {id(s): s for s in pool + live}.values():
             if not slot.done.is_set():
                 slot.error = RuntimeError("batcher stopped")
                 slot.done.set()
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, x: np.ndarray) -> PredictFuture:
+    def submit(self, x: np.ndarray, trace=None) -> PredictFuture:
         """Admit one window batch into the slot pool; raises
         :class:`Backpressure` (with the computed Retry-After) when the
         pool is at capacity and ``ValueError`` on bad window geometry —
@@ -270,7 +320,7 @@ class ContinuousBatcher:
                 f"windows shaped {x.shape}, want (n,) + "
                 f"{self._window_shape}"
             )
-        slot = _Slot(x)
+        slot = _Slot(x, trace)
         if slot.n == 0:
             # nothing to schedule: complete immediately (the empty reply
             # is still well-formed). Decided BEFORE the breaker check —
@@ -299,6 +349,7 @@ class ContinuousBatcher:
                     self.metrics.inc("rejected")
                 raise Backpressure(self.retry_after_s)
             self._pool.append(slot)
+            self._live[id(slot)] = slot
             self._cv.notify()
         if self.metrics is not None:
             self.metrics.inc("requests")
@@ -306,10 +357,10 @@ class ContinuousBatcher:
         return PredictFuture(slot, self.metrics)
 
     def predict(
-        self, x: np.ndarray, timeout: Optional[float] = None
+        self, x: np.ndarray, timeout: Optional[float] = None, trace=None
     ) -> np.ndarray:
         """submit + result in one call (the HTTP handler's path)."""
-        return self.submit(x).result(timeout)
+        return self.submit(x, trace=trace).result(timeout)
 
     # -- scheduling ----------------------------------------------------------
 
@@ -349,6 +400,7 @@ class ContinuousBatcher:
         complete when their scattered predictions arrive."""
         spans: List[Span] = []
         off = 0
+        now = time.perf_counter()
         while off < k:
             live = [s for s in self._pool if s.next < s.n]
             if not live:
@@ -358,6 +410,15 @@ class ContinuousBatcher:
                 take = min(share, slot.n - slot.next, k - off)
                 if take <= 0:
                     continue
+                if slot.next == 0:
+                    # first window of this request packs now: the
+                    # queue-wait span ends here (mergeable histogram +
+                    # the request's own trace)
+                    wait = now - slot.t_submit
+                    if slot.trace is not None:
+                        slot.trace.add("queue_wait", wait)
+                    if self.metrics is not None:
+                        self.metrics.hist_queue_wait.observe(wait)
                 if spans and spans[-1][0] is slot and (
                     spans[-1][1] + spans[-1][2] == slot.next
                 ):
@@ -385,6 +446,7 @@ class ContinuousBatcher:
             self._slab = np.empty(
                 (self.session.ladder[-1],) + self._window_shape, np.uint8
             )
+        t_pack = time.perf_counter()
         for slot, src, count, off in spans:
             self._slab[off : off + count] = slot.x[src : src + count]
         t0 = time.perf_counter()
@@ -406,6 +468,8 @@ class ContinuousBatcher:
                 self._pool = [
                     s for s in self._pool if id(s) not in failed
                 ]
+                for sid in failed:
+                    self._live.pop(sid, None)
             for slot, _, _, _ in spans:
                 if not slot.done.is_set():
                     slot.error = e
@@ -414,10 +478,41 @@ class ContinuousBatcher:
         dt = time.perf_counter() - t0
         if self.breaker is not None:
             self.breaker.record_success()
+        rung = max(1, self.session.padded_size(total))
+        dp = getattr(self.session, "dp", 1)
+        self._steps += 1
+        step_id = self._steps
+        t_scatter = time.perf_counter()
+        done_ids = []
         for slot, src, count, off in spans:
             slot.preds[src : src + count] = preds[off : off + count]
             slot.filled += count
             if slot.filled == slot.n:
+                done_ids.append(id(slot))
+        dt_scatter = time.perf_counter() - t_scatter
+        # span accounting per UNIQUE slot: fair-share may pack one
+        # request as several non-adjacent segments of this step, and
+        # double-adding the step's duration would break the
+        # span-sum~wall invariant the reply's timings promise
+        per_slot: Dict[int, Tuple[_Slot, int]] = {}
+        for slot, src, count, off in spans:
+            if slot.trace is not None:
+                prev = per_slot.get(id(slot))
+                per_slot[id(slot)] = (
+                    slot, count + (prev[1] if prev else 0)
+                )
+        for slot, count in per_slot.values():
+            slot.trace.add("pack", t0 - t_pack)
+            slot.trace.add_step(
+                dt, rung=rung, step=step_id,
+                occupancy=total / rung, dp=dp, windows=count,
+            )
+            slot.trace.add("scatter", dt_scatter)
+        # done is set only AFTER the trace spans landed: a handler
+        # reading timings() the instant result() wakes must see this
+        # step, not race it
+        for slot, _, _, _ in spans:
+            if slot.filled == slot.n and not slot.done.is_set():
                 slot.done.set()
         with self._cv:
             wps = total / max(dt, 1e-6)
@@ -427,11 +522,20 @@ class ContinuousBatcher:
                 else _THROUGHPUT_BETA * self._ema_wps
                 + (1 - _THROUGHPUT_BETA) * wps
             )
+            for sid in done_ids:
+                self._live.pop(sid, None)
+            self._rung_history.append({
+                "step": step_id,
+                "rung": rung,
+                "windows": total,
+                "fill": round(total / rung, 4),
+                "segments": len(spans),
+                "device_s": round(dt, 6),
+            })
         if self.metrics is not None:
             self.metrics.inc("batches")
-            self.metrics.observe_fill(
-                total, max(1, self.session.padded_size(total))
-            )
+            self.metrics.hist_device.observe(dt)
+            self.metrics.observe_fill(total, rung)
 
     def _loop(self) -> None:
         while True:

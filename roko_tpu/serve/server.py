@@ -24,6 +24,18 @@ Routes (payload schema: docs/SERVING.md):
   failing), or while the server is draining, so a load balancer stops
   routing here.
 - ``GET /metrics`` — Prometheus text (``serve/metrics.py``).
+- ``GET /tracez`` — request-trace ring (last N + slowest N replies with
+  their span breakdowns) plus a live scheduler snapshot (backlog,
+  in-flight segments, rung history) — docs/OBSERVABILITY.md.
+- ``POST /profilez?seconds=N`` — wrap the next N seconds of device
+  steps in a ``jax.profiler`` XPlane capture; returns the trace path
+  (TensorBoard-loadable). One capture at a time.
+
+Every ``POST /polish`` reply carries a ``request_id`` (minted here, or
+honored from an ``X-Roko-Request-Id`` header — the fleet supervisor
+assigns one per client request and re-sends it on failover re-dispatch)
+and a ``timings`` span breakdown (queue-wait, pack, device steps,
+scatter, stitch).
 
 Backpressure — queue full, breaker open, or draining — surfaces as
 **503** with a ``Retry-After`` header; malformed payloads as **400**;
@@ -46,6 +58,7 @@ import sys
 import threading
 import time
 import traceback
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
@@ -54,6 +67,8 @@ import numpy as np
 from roko_tpu import constants as C
 from roko_tpu.config import ServeConfig
 from roko_tpu.infer import VoteBoard
+from roko_tpu.obs import events as obs_events
+from roko_tpu.obs.trace import RequestTrace, TraceRing, new_request_id
 from roko_tpu.resilience import CircuitBreaker
 from roko_tpu.serve.batcher import Backpressure, MicroBatcher
 from roko_tpu.serve.metrics import ServeMetrics
@@ -122,7 +137,8 @@ def _decode_array(
 
 
 def _polish_windows(
-    batcher: MicroBatcher, payload: Dict[str, Any]
+    batcher: MicroBatcher, payload: Dict[str, Any],
+    trace: Optional[RequestTrace] = None,
 ) -> Dict[str, Any]:
     cfg = batcher.session.cfg.model
     draft = payload.get("draft")
@@ -155,10 +171,14 @@ def _polish_windows(
                 f"positions out of range: pos must lie in [0, {len(draft)})"
                 f" (draft length) and ins in [0, {C.MAX_INS}]"
             )
-    preds = batcher.predict(examples, timeout=REQUEST_TIMEOUT_S)
+    preds = batcher.predict(examples, timeout=REQUEST_TIMEOUT_S, trace=trace)
+    t0 = time.perf_counter()
     board = VoteBoard({contig: draft})
     board.add([contig] * n, positions, preds)
-    return {"contig": contig, "polished": board.stitch(contig), "windows": n}
+    polished = board.stitch(contig)
+    if trace is not None:
+        trace.add("stitch", time.perf_counter() - t0)
+    return {"contig": contig, "polished": polished, "windows": n}
 
 
 def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
@@ -186,6 +206,7 @@ def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
 def _polish_bam(
     batcher: MicroBatcher, payload: Dict[str, Any],
     data_root: Optional[str] = None,
+    trace: Optional[RequestTrace] = None,
 ) -> Dict[str, Any]:
     """Extractor convenience path: feature-extract a server-local
     ref+BAM through ``features.pipeline`` and polish every contig
@@ -224,9 +245,13 @@ def _polish_bam(
         ):
             board.add(
                 names, positions,
-                batcher.predict(x, timeout=REQUEST_TIMEOUT_S),
+                batcher.predict(x, timeout=REQUEST_TIMEOUT_S, trace=trace),
             )
-    return {"contigs": board.stitch_all(), "windows": n}
+        t0 = time.perf_counter()
+        contigs = board.stitch_all()
+        if trace is not None:
+            trace.add("stitch", time.perf_counter() - t0)
+    return {"contigs": contigs, "windows": n}
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -331,10 +356,14 @@ class _Handler(JsonRequestHandler):
     # set by make_server on the class copy
     batcher: MicroBatcher
     metrics: ServeMetrics
+    ring: Optional[TraceRing] = None
     data_root: Optional[str] = None
     worker_id: Optional[int] = None
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] == "/tracez":
+            self._handle_tracez()
+            return
         if self.path == "/healthz":
             session = self.batcher.session
             breaker = getattr(self.server, "breaker", None)
@@ -397,7 +426,85 @@ class _Handler(JsonRequestHandler):
         else:
             self._reply_json(404, {"error": f"no route {self.path}"})
 
+    def _handle_tracez(self) -> None:
+        """Trace ring + live scheduler snapshot (docs/OBSERVABILITY.md):
+        ``?last=N&slowest=M`` bound how many records return."""
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query
+        )
+
+        def _qint(name: str) -> Optional[int]:
+            try:
+                return max(1, int(query[name][0]))
+            except (KeyError, IndexError, ValueError):
+                return None
+
+        body: Dict[str, Any] = {
+            "worker_id": self.worker_id,
+            "batching": getattr(self.batcher, "BATCHING_MODE", "deadline"),
+        }
+        ring = self.ring
+        if ring is not None:
+            body.update(ring.snapshot(_qint("last"), _qint("slowest")))
+        snap = getattr(self.batcher, "snapshot", None)
+        if snap is not None:
+            body["scheduler"] = snap()
+        self._reply_json(200, body)
+
+    def _handle_profilez(self) -> None:
+        """On-demand XPlane capture: hold ``jax.profiler`` open over the
+        next N seconds of device steps and return the trace directory.
+        One capture at a time (409 while one runs); the capture runs on
+        THIS handler thread — the reply lands when the trace is on disk
+        and loadable."""
+        import tempfile
+
+        from roko_tpu.utils.profiling import capture_device_trace
+
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query
+        )
+        try:
+            seconds = float(query.get("seconds", ["3"])[0])
+        except ValueError:
+            self._reply_json(400, {"error": "seconds must be a number"})
+            return
+        seconds = max(0.1, min(seconds, 120.0))
+        lock: threading.Lock = self.server._profile_lock  # type: ignore[attr-defined]
+        if not lock.acquire(blocking=False):
+            self._reply_json(
+                409, {"error": "a profile capture is already running"}
+            )
+            return
+        try:
+            trace_dir = tempfile.mkdtemp(prefix="roko-profilez-")
+            obs_events.emit(
+                "serve", "profile_start",
+                trace_dir=trace_dir, seconds=seconds, quiet=True,
+            )
+            capture_device_trace(trace_dir, seconds)
+        except Exception as e:
+            self.metrics.inc("errors")
+            traceback.print_exc(file=sys.stderr)
+            self._reply_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        finally:
+            lock.release()
+        obs_events.emit(
+            "serve", "profile_done",
+            trace_dir=trace_dir, seconds=seconds, quiet=True,
+        )
+        self._reply_json(
+            200,
+            {"trace_dir": trace_dir, "seconds": seconds,
+             "hint": "load in TensorBoard: tensorboard --logdir "
+                     + trace_dir},
+        )
+
     def do_POST(self) -> None:  # noqa: N802 - http.server API
+        if self.path.split("?", 1)[0] == "/profilez":
+            self._handle_profilez()
+            return
         if self.path != "/polish":
             self._reply_json(404, {"error": f"no route {self.path}"})
             return
@@ -433,6 +540,12 @@ class _Handler(JsonRequestHandler):
             self._handle_polish()
 
     def _handle_polish(self) -> None:
+        # request identity: honor the id a front end (or client)
+        # assigned — across fleet failover the retried dispatch carries
+        # the SAME id, so the event log and /tracez see one request —
+        # else mint one here
+        rid = self.headers.get("X-Roko-Request-Id") or new_request_id()
+        trace = RequestTrace(rid, worker_id=self.worker_id)
         try:
             raw = self._read_body()
             if raw is None:
@@ -441,9 +554,16 @@ class _Handler(JsonRequestHandler):
             if not isinstance(payload, dict):
                 raise _BadRequest("payload must be a JSON object")
             if "bam" in payload:
-                result = _polish_bam(self.batcher, payload, self.data_root)
+                result = _polish_bam(
+                    self.batcher, payload, self.data_root, trace=trace
+                )
             else:
-                result = _polish_windows(self.batcher, payload)
+                result = _polish_windows(self.batcher, payload, trace=trace)
+            trace.windows = int(result.get("windows", 0))
+            result["request_id"] = rid
+            result["timings"] = trace.timings()
+            if self.ring is not None:
+                self.ring.record(trace)
             self._reply_json(200, result)
         except Backpressure as e:
             self._reply_json(
@@ -540,8 +660,9 @@ def make_server(
         breaker = breaker or batcher.breaker
     metrics.breaker = breaker
     metrics.cpu_fallback = lambda: getattr(session, "failed_over", False)
+    ring = TraceRing(serve_cfg.trace_ring, serve_cfg.trace_slowest)
     handler = type("RokoServeHandler", (_Handler,), {
-        "batcher": batcher, "metrics": metrics,
+        "batcher": batcher, "metrics": metrics, "ring": ring,
         "data_root": serve_cfg.data_root,
         "worker_id": worker_id,
     })
@@ -554,6 +675,8 @@ def make_server(
     server.metrics = metrics  # type: ignore[attr-defined]
     server.session = session  # type: ignore[attr-defined]
     server.breaker = breaker  # type: ignore[attr-defined]
+    server.ring = ring  # type: ignore[attr-defined]
+    server._profile_lock = threading.Lock()  # type: ignore[attr-defined]
     init_lifecycle(server, rcfg.drain_deadline_s, warming=warming)
     return server
 
@@ -597,6 +720,32 @@ def drain(
     return left == 0
 
 
+def sigusr2_dump(server: ThreadingHTTPServer, log=None) -> None:
+    """Operator-triggered post-mortem WITHOUT killing the service
+    (docs/OBSERVABILITY.md): every thread's stack (the watchdog's dump
+    machinery) plus the live scheduler snapshot to stderr —
+    ``kill -USR2 <pid>`` answers "what is this process doing right
+    now". Wired to SIGUSR2 by :func:`serve_forever` for both the
+    worker server and the fleet supervisor front end."""
+    from roko_tpu.resilience.watchdog import dump_thread_stacks
+
+    snap = None
+    batcher = getattr(server, "batcher", None)
+    snap_fn = getattr(batcher, "snapshot", None)
+    if snap_fn is not None:
+        try:
+            snap = snap_fn()
+        except Exception:  # diagnostics never take the service down
+            pass
+    emit_log = log or (lambda m: print(m, file=sys.stderr, flush=True))
+    obs_events.emit(
+        "serve", "sigusr2_dump", log=emit_log,
+        threads=threading.active_count(),
+        scheduler=json.dumps(snap) if snap is not None else "n/a",
+    )
+    emit_log(dump_thread_stacks())
+
+
 def serve_forever(server: ThreadingHTTPServer, log=print, drain_fn=None) -> None:
     """Blocking loop with clean shutdown on Ctrl-C and a graceful
     SIGTERM drain (finish in-flight, reject new, then exit).
@@ -616,10 +765,15 @@ def serve_forever(server: ThreadingHTTPServer, log=print, drain_fn=None) -> None
             target=drain_fn, name="roko-serve-drain", daemon=True
         ).start()
 
+    def _on_sigusr2(signum, frame):
+        sigusr2_dump(server)
+
     try:
         # only the main thread may set signal handlers; tests drive
         # serve_forever from worker threads and call drain() directly
         signal.signal(signal.SIGTERM, _on_sigterm)
+        if hasattr(signal, "SIGUSR2"):  # not on Windows
+            signal.signal(signal.SIGUSR2, _on_sigusr2)
     except ValueError:
         pass
     try:

@@ -17,7 +17,6 @@ window counts).
 
 from __future__ import annotations
 
-import sys
 import threading
 from typing import Any, Dict, Optional, Sequence, Set, Tuple
 
@@ -36,6 +35,7 @@ from roko_tpu.infer import (
     rung_for,
 )
 from roko_tpu.models.model import RokoModel
+from roko_tpu.obs import events as obs_events
 from roko_tpu.resilience import DeadlinePolicy, HangError, call_with_deadline
 from roko_tpu.parallel.mesh import (
     AXIS_DP,
@@ -271,12 +271,12 @@ class PolishSession:
         except HangError:
             if self.resilience.hang_fallback != "cpu":
                 raise
-            print(
-                "ROKO_FAILOVER serve: device hang — session permanently "
+            obs_events.emit(
+                "failover", "cpu_fallback",
+                text="serve: device hang — session permanently "
                 "failed over to host-CPU predict (degraded); healthz "
                 "cpu_fallback=true, metrics roko_serve_cpu_fallback=1",
-                file=sys.stderr,
-                flush=True,
+                stage="serve", shape=x.shape[0],
             )
             self._cpu_predict = make_cpu_predict(
                 self.model, self._params_host

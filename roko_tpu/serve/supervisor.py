@@ -50,6 +50,8 @@ from http.server import ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from roko_tpu.config import ModelConfig, RokoConfig
+from roko_tpu.obs import events as obs_events
+from roko_tpu.obs.trace import new_request_id
 from roko_tpu.parallel.mesh import fleet_worker_env, resolve_fleet_topology
 from roko_tpu.serve.fleet import (
     BOOT_VERSION,
@@ -81,7 +83,16 @@ class _FrontHandler(JsonRequestHandler):
     fleet: Fleet
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
-        if self.path == "/healthz":
+        if self.path.split("?", 1)[0] == "/tracez":
+            # aggregate view: every worker's trace ring + scheduler
+            # snapshot keyed by worker id (docs/OBSERVABILITY.md) — the
+            # request_id assigned here at the front end is what each
+            # worker's records carry, so one id greps across the fleet
+            parts = self.path.split("?", 1)
+            self._reply_json(
+                200, self.fleet.tracez(parts[1] if len(parts) > 1 else "")
+            )
+        elif self.path == "/healthz":
             body = self.fleet.summary()
             if self.server._draining.is_set():  # type: ignore[attr-defined]
                 body["status"], body["code"] = "draining", 503
@@ -174,7 +185,14 @@ class _FrontHandler(JsonRequestHandler):
             if body is None:
                 return  # error reply already sent
             fleet.inc("requests")
-            code, reply, extra = fleet.post_polish(body)
+            # the request id is minted HERE (or honored from the
+            # client's header) and preserved across failover
+            # re-dispatch: the reply, the worker's /tracez record, and
+            # the event log all carry the front end's id
+            rid = (
+                self.headers.get("X-Roko-Request-Id") or new_request_id()
+            )
+            code, reply, extra = fleet.post_polish(body, request_id=rid)
             if code == 503:
                 self.close_connection = True
             self._reply(code, reply, extra=extra)
@@ -451,10 +469,11 @@ def run_supervisor(
             boot_version = pinned["version"]
             boot_model = pinned.get("model_path") or model_path
             boot_cfg = _version_config(cfg, pinned)
-            log(
-                f"ROKO_ROLLOUT event=version_pinned version={boot_version}"
-                f" bundle_digest={str(pinned.get('bundle_digest', '?'))[:12]}"
-                " — restart re-pins the landed rollout version"
+            obs_events.emit(
+                "rollout", "version_pinned", log=log,
+                suffix="— restart re-pins the landed rollout version",
+                version=boot_version,
+                bundle_digest=str(pinned.get("bundle_digest", "?"))[:12],
             )
     fleet.install_boot_spec(
         worker_launch_spec(

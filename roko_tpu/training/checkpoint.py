@@ -29,6 +29,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 import jax
 import orbax.checkpoint as ocp
 
+from roko_tpu.obs import events as obs_events
+
 #: committed last, atomically — its presence IS the commit record
 MANIFEST_NAME = "roko_manifest.json"
 
@@ -334,9 +336,9 @@ class CheckpointManager:
             if status == "corrupt" or (
                 status == "unverified" and uses_manifests
             ):
-                self._log(
-                    "ROKO_GUARD event=ckpt_corrupt "
-                    f"checkpoint={path} detail={detail!r} action=fallback"
+                obs_events.emit(
+                    "guard", "ckpt_corrupt", log=self._log,
+                    checkpoint=path, detail=repr(detail), action="fallback",
                 )
                 continue
             cand_like = like
@@ -347,9 +349,9 @@ class CheckpointManager:
             try:
                 return self._restore_at(name, cand_like)
             except Exception as e:  # restore blew up on a "verified" dir
-                self._log(
-                    "ROKO_GUARD event=ckpt_restore_failed "
-                    f"checkpoint={path} error={e!r} action=fallback"
+                obs_events.emit(
+                    "guard", "ckpt_restore_failed", log=self._log,
+                    checkpoint=path, error=repr(e), action="fallback",
                 )
                 continue
         if cands:

@@ -22,8 +22,10 @@ Every event is one structured ``ROKO_GUARD`` line (``event=skip``,
 ``event=rollback``, ``event=param_nonfinite``, plus the checkpoint
 integrity chain's ``event=ckpt_corrupt`` from
 ``roko_tpu/training/checkpoint.py``) so a log scrape sees the whole
-failure-handling story with one grep. This module is host-side only —
-the device-side flags are produced in ``loop.py``.
+failure-handling story with one grep. The format (and the optional
+``--event-log`` JSONL sink every line also lands in) lives in
+:mod:`roko_tpu.obs.events` — docs/OBSERVABILITY.md. This module is
+host-side only — the device-side flags are produced in ``loop.py``.
 """
 
 from __future__ import annotations
@@ -32,20 +34,18 @@ import math
 from typing import Any, Callable, Dict
 
 from roko_tpu.config import GuardConfig
+from roko_tpu.obs import events
 
 #: prefix of every structured sentinel/integrity log line
-GUARD_PREFIX = "ROKO_GUARD"
+GUARD_PREFIX = events.legacy_prefix("guard")
 
 
 def guard_line(event: str, **fields) -> str:
     """One structured log line: ``ROKO_GUARD event=... k=v ...``.
-    Floats are compacted; key order follows the call site."""
-    parts = [f"{GUARD_PREFIX} event={event}"]
-    for k, v in fields.items():
-        if isinstance(v, float):
-            v = f"{v:.6g}"
-        parts.append(f"{k}={v}")
-    return " ".join(parts)
+    Floats are compacted; key order follows the call site. (Formatting
+    delegates to the shared event plane; this wrapper remains the
+    training-local spelling.)"""
+    return events.format_line("guard", event, fields)
 
 
 class RollbackRequested(RuntimeError):
@@ -127,16 +127,14 @@ class TrainGuard:
 
         self.consecutive_bad += 1
         self.counters[f"skipped_{reason}"] += 1
-        self._log(
-            guard_line(
-                "skip",
-                reason=reason,
-                step=step,
-                loss=loss,
-                ema=self.ema if self.ema is not None else float("nan"),
-                consecutive=self.consecutive_bad,
-                max_bad_steps=self.cfg.max_bad_steps,
-            )
+        events.emit(
+            "guard", "skip", log=self._log,
+            reason=reason,
+            step=step,
+            loss=loss,
+            ema=self.ema if self.ema is not None else float("nan"),
+            consecutive=self.consecutive_bad,
+            max_bad_steps=self.cfg.max_bad_steps,
         )
         if self.consecutive_bad >= self.cfg.max_bad_steps:
             raise RollbackRequested(reason, step)
@@ -147,7 +145,10 @@ class TrainGuard:
         optimizer math despite finite grads). The old params were donated
         — skipping cannot help, so this rolls back immediately."""
         self.counters["param_nonfinite"] += 1
-        self._log(guard_line("param_nonfinite", step=step, action="rollback"))
+        events.emit(
+            "guard", "param_nonfinite", log=self._log,
+            step=step, action="rollback",
+        )
         raise RollbackRequested("param_nonfinite", step)
 
     # -- checkpoint round-trip ------------------------------------------

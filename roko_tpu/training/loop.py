@@ -28,6 +28,7 @@ from jax.sharding import Mesh
 
 from roko_tpu.config import RokoConfig
 from roko_tpu.models.model import RokoModel
+from roko_tpu.obs import events as obs_events
 from roko_tpu.parallel.mesh import (
     AXIS_DP,
     data_sharding,
@@ -639,12 +640,12 @@ def train(
                         # the rest of THIS epoch rides a different
                         # shuffle than its trained prefix (coverage of
                         # later epochs is unaffected)
-                        log(
-                            "ROKO_GUARD event=legacy_resume "
-                            "detail=pre-datapipe mid-epoch checkpoint; "
+                        obs_events.emit(
+                            "guard", "legacy_resume", log=log,
+                            detail="pre-datapipe mid-epoch checkpoint; "
                             "the remainder of the current epoch replays "
                             "on the new input-pipeline shuffle, not the "
-                            "one its prefix trained on"
+                            "one its prefix trained on",
                         )
                 elif "epoch" in restored:
                     start_epoch = int(jax.device_get(restored["epoch"])) + 1
@@ -920,14 +921,12 @@ def train(
                         "replays deterministically — inspect the data/"
                         "config instead of rolling back again"
                     ) from rb
-                log(
-                    guard_lib.guard_line(
-                        "rollback",
-                        reason=rb.reason,
-                        step=rb.step,
-                        rollbacks=attempt,
-                        max_rollbacks=gcfg.max_rollbacks,
-                    )
+                obs_events.emit(
+                    "guard", "rollback", log=log,
+                    reason=rb.reason,
+                    step=rb.step,
+                    rollbacks=attempt,
+                    max_rollbacks=gcfg.max_rollbacks,
                 )
     finally:
         manager.close()

@@ -106,6 +106,27 @@ def device_trace(trace_dir: Optional[str]) -> Iterator[None]:
         yield
 
 
+def capture_device_trace(
+    trace_dir: str,
+    seconds: float,
+    sleep: Callable[[float], None] = time.sleep,
+) -> str:
+    """Hold a ``jax.profiler`` XPlane capture open for ``seconds`` of
+    wall time and return ``trace_dir`` — the live-service half of
+    :func:`device_trace`: ``POST /profilez?seconds=N`` wraps the next N
+    seconds of device steps without restarting anything
+    (docs/OBSERVABILITY.md). The capture covers whatever the process
+    dispatches in the window; the result loads in TensorBoard."""
+    import jax
+
+    jax.profiler.start_trace(trace_dir)
+    try:
+        sleep(max(0.0, seconds))
+    finally:
+        jax.profiler.stop_trace()
+    return trace_dir
+
+
 @contextlib.contextmanager
 def annotate(name: str) -> Iterator[None]:
     """Named region that shows up in device traces (TraceAnnotation)."""

@@ -24,10 +24,16 @@ Failure modes are injected through the environment:
 - ``STUB_ERROR_EVERY=N``     — every Nth polish replies 500 and counts
   in errors_total (the rollout canary-gate trigger)
 - ``STUB_P99_S=T``           — report this request p99 in /metrics
+- ``STUB_HIST_MS=T``         — render a one-observation
+  ``roko_request_latency_seconds`` histogram whose sample sits at T
+  milliseconds (the supervisor bucket-sum aggregation tests tell
+  workers apart by it)
 
 Replies carry this process's pid so tests can see WHICH incarnation
-answered across restarts; /metrics renders live requests/errors
-counters beside the static passthrough series.
+answered across restarts (and echo ``X-Roko-Request-Id`` as
+``request_id``, like the real server, so request-id propagation across
+failover is testable on the stub fleet); /metrics renders live
+requests/errors counters beside the static passthrough series.
 """
 
 from __future__ import annotations
@@ -56,7 +62,24 @@ VERSION = os.environ.get("STUB_VERSION", "")
 RETRY_AFTER_S = os.environ.get("STUB_RETRY_AFTER_S", "")
 ERROR_EVERY = int(os.environ.get("STUB_ERROR_EVERY", "0"))
 P99_S = os.environ.get("STUB_P99_S", "")
+HIST_MS = os.environ.get("STUB_HIST_MS", "")
 ERRORS = 0
+
+
+def _hist_rows():
+    """A minimal mergeable-histogram body: one observation at
+    STUB_HIST_MS milliseconds over the shared fixed buckets."""
+    # the stub launches as a script (sys.path[0] = tests/), so the repo
+    # root needs adding before roko_tpu.obs resolves; obs.hist is
+    # deliberately jax-free, keeping the stub's ~100 ms spawn intact
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from roko_tpu.obs.hist import HistogramFamily
+
+    fam = HistogramFamily("roko_request_latency_seconds")
+    fam.observe(float(HIST_MS) / 1e3)
+    return chr(10).join(fam.render()) + chr(10)
 
 METRICS = """\
 # TYPE roko_serve_breaker_state gauge
@@ -125,6 +148,8 @@ class Handler(BaseHTTPRequestHandler):
                     'roko_serve_request_latency_seconds{quantile="0.99"} '
                     f"{float(P99_S)}\n"
                 )
+            if HIST_MS:
+                text += _hist_rows()
             self._reply(200, text.encode(), ctype="text/plain")
         else:
             self._reply_json(404, {"error": "no route"})
@@ -166,6 +191,9 @@ class Handler(BaseHTTPRequestHandler):
                 return
             reply = {"contig": "stub", "polished": f"STUB-{os.getpid()}",
                      "windows": n}
+            rid = self.headers.get("X-Roko-Request-Id")
+            if rid:
+                reply["request_id"] = rid
             if VERSION:
                 reply["version"] = VERSION
             self._reply_json(200, reply)

@@ -13,6 +13,7 @@ traffic against a real 2-worker fleet (ISSUE satellite: zero client
 errors)."""
 
 import dataclasses
+import json
 import threading
 import time
 
@@ -652,6 +653,55 @@ def test_fleet_mixed_traffic_zero_client_errors(tmp_path, rng):
             text = r.read().decode()
         assert 'roko_serve_padding_efficiency{worker="' in text
         assert 'roko_serve_scheduler_occupancy{worker="' in text
+        # observability plane (docs/OBSERVABILITY.md): /tracez answers
+        # on the front end with every worker's ring + scheduler
+        # snapshot, and the front-assigned request ids appear on the
+        # worker that served them
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/tracez", timeout=10
+        ) as r:
+            tz = json.loads(r.read())
+        assert sorted(tz["workers"]) == ["0", "1"]
+        traced = [
+            rec
+            for body in tz["workers"].values()
+            for rec in body.get("last", [])
+        ]
+        assert traced, tz
+        assert all(len(rec["request_id"]) == 16 for rec in traced)
+        assert all("device" in rec["spans"] for rec in traced)
+        # mergeable histograms: the fleet-level bucket-summed p99 is
+        # bracketed by the per-worker bucket-derived p99s (percentile
+        # passthrough can't aggregate; bucket sums can)
+        from roko_tpu.obs.hist import (
+            parse_histogram_rows,
+            quantile_from_buckets,
+        )
+
+        rows = parse_histogram_rows(text, "roko_request_latency_seconds")
+
+        def cum(pred):
+            return sorted(
+                (
+                    float("inf") if dict(k)["le"] == "+Inf"
+                    else float(dict(k)["le"]),
+                    int(v),
+                )
+                for k, v in rows.items()
+                if dict(k).get("__series__") == "bucket"
+                and "size_class" not in dict(k) and pred(dict(k))
+            )
+
+        fleet_cum = cum(lambda d: "worker" not in d)
+        worker_cums = [
+            cum(lambda d, w=w: d.get("worker") == w) for w in ("0", "1")
+        ]
+        worker_cums = [c for c in worker_cums if c and c[-1][1] > 0]
+        assert fleet_cum and len(worker_cums) == 2
+        assert fleet_cum[-1][1] == sum(c[-1][1] for c in worker_cums)
+        p99s = [quantile_from_buckets(c, 0.99) for c in worker_cums]
+        fleet_p99 = quantile_from_buckets(fleet_cum, 0.99)
+        assert min(p99s) <= fleet_p99 <= max(p99s)
     finally:
         if server is not None:
             server.shutdown()

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Pretty-print a running service's observability surfaces
+(docs/OBSERVABILITY.md) — the operator console for the ROADMAP item 6
+TPU sessions:
+
+    python tools/trace_probe.py http://127.0.0.1:8000
+    python tools/trace_probe.py --tracez http://127.0.0.1:8000
+    python tools/trace_probe.py --metrics http://127.0.0.1:8000
+
+``--tracez`` (the default) fetches ``GET /tracez`` and renders the
+slowest-requests table (request id, windows, total, span breakdown)
+plus the live scheduler snapshot (backlog, in-flight segments, recent
+rung history). Against a fleet supervisor the body is keyed by worker
+id and every worker renders in turn.
+
+``--metrics`` fetches ``GET /metrics`` and derives p50/p99 from the
+MERGEABLE histogram rows (`roko_request_latency_seconds_bucket` and the
+queue-wait / device-time decomposition) — on a supervisor these are the
+bucket-summed fleet rows, so the printed p99 is the fleet p99, not a
+per-worker passthrough.
+
+Stdlib-only, like every tools/ probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from roko_tpu.obs.hist import (  # noqa: E402 - path bootstrap above
+    parse_histogram_rows,
+    quantile_from_buckets,
+)
+
+#: the mergeable histogram families (mirrors
+#: roko_tpu.serve.metrics.HISTOGRAM_SERIES without importing the serve
+#: stack — the probe must not pay a jax import to pretty-print JSON)
+HISTOGRAM_SERIES = (
+    "roko_request_latency_seconds",
+    "roko_queue_wait_seconds",
+    "roko_device_time_seconds",
+)
+
+
+def _fetch(url: str, timeout: float) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read()
+
+
+def _ms(seconds) -> str:
+    if seconds is None:
+        return "n/a"
+    return f"{float(seconds) * 1e3:.1f}ms"
+
+
+def _span_text(spans: dict) -> str:
+    order = ("queue_wait", "pack", "device", "scatter", "stitch")
+    parts = [f"{k}={_ms(spans[k])}" for k in order if k in spans]
+    parts += [
+        f"{k}={_ms(v)}" for k, v in sorted(spans.items()) if k not in order
+    ]
+    return " ".join(parts)
+
+
+def print_tracez(body: dict, label: str = "") -> None:
+    if "workers" in body and "last" not in body:
+        # supervisor aggregate: one section per worker
+        for wid, wbody in sorted(body["workers"].items()):
+            print_tracez(wbody or {}, label=f"worker {wid}")
+        if not body["workers"]:
+            print("(no worker answered /tracez)")
+        return
+    head = f"--- {label} ---" if label else "--- tracez ---"
+    print(head)
+    print(
+        f"requests seen: {body.get('seen', 0)}  "
+        f"batching: {body.get('batching', '?')}"
+    )
+    slowest = body.get("slowest") or []
+    if slowest:
+        print(f"{'request_id':<18} {'windows':>7} {'total':>9}  spans")
+        for rec in slowest:
+            print(
+                f"{rec.get('request_id', '?'):<18} "
+                f"{rec.get('windows', 0):>7} "
+                f"{_ms(rec.get('total_s')):>9}  "
+                f"{_span_text(rec.get('spans') or {})}"
+            )
+    else:
+        print("(no completed traces yet)")
+    sched = body.get("scheduler")
+    if sched:
+        print(
+            f"scheduler: backlog={sched.get('backlog_windows', 0)}w "
+            f"occupancy={sched.get('occupancy', 0)} "
+            f"steps={sched.get('steps', 0)} "
+            f"ema={sched.get('ema_windows_per_s') or '?'}w/s "
+            f"in_flight={len(sched.get('in_flight') or [])}"
+        )
+        for seg in (sched.get("in_flight") or [])[:8]:
+            print(
+                f"  in-flight {seg.get('request_id') or '?'}: "
+                f"{seg.get('packed', 0)}/{seg.get('windows', 0)} packed, "
+                f"{seg.get('filled', 0)} filled, age {seg.get('age_s')}s"
+            )
+        hist = sched.get("rung_history") or []
+        if hist:
+            tail = hist[-8:]
+            print(
+                "  recent steps: "
+                + " ".join(
+                    f"#{h['step']}r{h['rung']}@{h['fill']}" for h in tail
+                )
+            )
+    print()
+
+
+def print_metrics(text: str) -> None:
+    print("--- mergeable histograms (fleet-level when scraped from a "
+          "supervisor) ---")
+    for name in HISTOGRAM_SERIES:
+        rows = parse_histogram_rows(text, name)
+        # the unlabeled aggregate row set (no size_class, no worker)
+        buckets = sorted(
+            (
+                (float("inf") if dict(k)["le"] == "+Inf"
+                 else float(dict(k)["le"]), int(v))
+                for k, v in rows.items()
+                if dict(k).get("__series__") == "bucket"
+                and set(dict(k)) == {"__series__", "le"}
+            ),
+        )
+        if not buckets:
+            continue
+        p50 = quantile_from_buckets(buckets, 0.50)
+        p99 = quantile_from_buckets(buckets, 0.99)
+        print(
+            f"{name:<36} count={buckets[-1][1]:>7} "
+            f"p50~{_ms(p50)} p99~{_ms(p99)}"
+        )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("url", nargs="?", default=None,
+                    help="service base URL (worker or fleet supervisor)")
+    ap.add_argument("--tracez", metavar="URL", default=None,
+                    help="fetch URL/tracez (same as the positional URL)")
+    ap.add_argument("--metrics", metavar="URL", default=None,
+                    help="fetch URL/metrics and derive histogram p50/p99")
+    ap.add_argument("--last", type=int, default=None,
+                    help="cap the last-N traces requested from /tracez")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the raw /tracez JSON instead of the table")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    args = ap.parse_args(argv)
+
+    base = (args.tracez or args.metrics or args.url or "").rstrip("/")
+    if not base:
+        ap.error("name a service URL (positional, --tracez, or --metrics)")
+    try:
+        if args.metrics:
+            print_metrics(_fetch(base + "/metrics", args.timeout).decode())
+            return 0
+        q = f"?last={args.last}" if args.last else ""
+        body = json.loads(_fetch(base + "/tracez" + q, args.timeout))
+        if args.json:
+            print(json.dumps(body, indent=2))
+        else:
+            print_tracez(body)
+        return 0
+    except OSError as e:
+        print(f"trace_probe: cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
