@@ -325,6 +325,10 @@ def _build_config(args: argparse.Namespace):
         prefetch="prefetch", queue_regions="queue_regions",
         max_batch_delay_ms="batch_delay_ms",
     )
+    distpolish = over(
+        base.distpolish,
+        unit_bases="unit_bases", unit_attempts="unit_attempts",
+    )
     resilience = over(
         base.resilience,
         predict_deadline_s="predict_deadline", hang_fallback="hang_fallback",
@@ -360,8 +364,8 @@ def _build_config(args: argparse.Namespace):
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, data=data, mesh=mesh, serve=serve,
-        fleet=fleet, pipeline=pipeline, resilience=resilience,
-        compile=compile_cfg, guard=guard,
+        fleet=fleet, pipeline=pipeline, distpolish=distpolish,
+        resilience=resilience, compile=compile_cfg, guard=guard,
     )
 
 
@@ -549,6 +553,42 @@ def cmd_polish(args: argparse.Namespace) -> int:
             "would write the same path on a shared filesystem. Run the "
             "staged `features` + `inference` commands instead."
         )
+    if args.distributed:
+        # fleet-distributed map-reduce polish (docs/PIPELINE.md
+        # "Distributed polish"): per-contig work units over forked
+        # serve workers, per-unit commit/retry through the resume
+        # journal — byte-identical to the single-process path
+        if args.staged or args.keep_hdf5:
+            raise SystemExit(
+                "polish --distributed drives the fleet workers' own "
+                "streaming stacks; it cannot combine with --staged or "
+                "--keep-hdf5"
+            )
+        if jax.process_count() > 1:
+            raise SystemExit(
+                "polish --distributed forks its own worker fleet; run "
+                "it from one host, not under a pod launcher"
+            )
+        from roko_tpu.pipeline.distpolish import (
+            PoisonedUnit,
+            run_distributed_polish,
+        )
+        from roko_tpu.resilience import JournalMismatch
+
+        try:
+            run_distributed_polish(
+                args.ref, args.X, args.model, args.out, cfg,
+                seed=args.seed, resume=args.resume,
+            )
+        except (PoisonedUnit, JournalMismatch) as e:
+            # named-contig quarantine / identity refusal: a clean
+            # nonzero exit with the actionable message, not a traceback
+            print(f"polish: {e}", file=sys.stderr)
+            return 1
+        print(f"wrote polished contigs to {args.out}")
+        if args.truth:
+            _print_assess(args.out, args.truth)
+        return 0
     if not args.staged and jax.process_count() == 1:
         from roko_tpu.pipeline import run_streaming_polish
 
@@ -1316,6 +1356,37 @@ def build_parser() -> argparse.ArgumentParser:
         "--staged", action="store_true",
         help="force the two-stage features->HDF5->inference path instead "
         "of the default streaming engine (docs/PIPELINE.md)",
+    )
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="shard the job by contig across a forked worker fleet "
+        "(--workers; default 2): per-unit commit/retry through the "
+        "resume journal — a SIGKILLed worker costs one contig's re-run "
+        "and the FASTA stays byte-identical to a single-process run; "
+        "GET /jobz on the printed front-end port reports per-unit "
+        "state (docs/PIPELINE.md 'Distributed polish')",
+    )
+    p.add_argument(
+        "--workers", type=_workers_type, default=None,
+        help="with --distributed: fleet worker process count ('auto' = "
+        "visible devices / --devices-per-worker; default 2)",
+    )
+    p.add_argument(
+        "--devices-per-worker", type=int, default=None,
+        help="with --distributed: devices each fleet worker may see "
+        "(visible-device pinning; default 0 = no pinning, CPU only)",
+    )
+    p.add_argument(
+        "--unit-bases", type=int, default=None,
+        help="with --distributed: split contigs longer than this into "
+        "region-aligned span units, merged coordinator-side "
+        "(byte-identical; default 1000000, 0 = whole-contig units only)",
+    )
+    p.add_argument(
+        "--unit-attempts", type=int, default=None,
+        help="with --distributed: dispatch attempts per unit (each on a "
+        "not-yet-excluded worker) before the contig is quarantined and "
+        "the job fails naming it (default 3)",
     )
     p.add_argument(
         "--resume", action="store_true",

@@ -507,6 +507,60 @@ class PipelineConfig:
 
 
 @dataclass(frozen=True)
+class DistPolishConfig:
+    """Distributed polish over the worker fleet (``roko-tpu polish
+    --distributed``; roko_tpu/pipeline/distpolish.py, docs/PIPELINE.md
+    "Distributed polish"): a whole-genome job splits into per-contig
+    work units (giant contigs into region-aligned block spans),
+    dispatched across fleet workers with per-unit commit/retry through
+    the crash-resume journal — a killed worker costs one unit's re-run
+    and the output stays byte-identical to single-process polish."""
+
+    #: contigs longer than this split into multiple span units at the
+    #: deterministic extraction-region boundaries (the same span table
+    #: the single-process fan-out walks, so the union of the units'
+    #: windows is exactly the single-process window set); span units
+    #: return raw predictions and the coordinator votes + stitches.
+    #: 0 = whole-contig units only
+    unit_bases: int = 1_000_000
+    #: distinct dispatch attempts one unit gets (each on a worker not
+    #: yet excluded for it) before it is QUARANTINED and the job fails
+    #: loudly naming the contig — never a silent gap in the FASTA
+    unit_attempts: int = 3
+    #: hard cap on units in flight across the fleet; 0 = auto
+    #: (``inflight_per_worker`` x worker count)
+    max_inflight_units: int = 0
+    #: units in flight per READY worker — the live limit degrades with
+    #: the fleet (a 2-of-4-ready fleet carries half the units) instead
+    #: of failing the job
+    inflight_per_worker: int = 2
+    #: hard deadline on one unit's dispatch round-trip (extraction +
+    #: predict + stitch on the worker). The watchdog shape: on expiry
+    #: the attempt fails LOUDLY and re-dispatches — never a silent
+    #: park behind a hung worker (the fleet's heartbeat supervision
+    #: kills the hang independently)
+    unit_timeout_s: float = 600.0
+    #: scheduler poll cadence while parked (fleet draining, no ready
+    #: workers, or every pending unit in backoff)
+    park_poll_s: float = 0.25
+    #: seconds to wait for the first worker to warm before the job
+    #: refuses to start (and for a fully-unready fleet mid-job before
+    #: the coordinator gives up)
+    ready_timeout_s: float = 600.0
+
+    def __post_init__(self) -> None:
+        if self.unit_attempts < 1:
+            raise ValueError(
+                f"unit_attempts must be >= 1; got {self.unit_attempts}"
+            )
+        if self.inflight_per_worker < 1:
+            raise ValueError(
+                "inflight_per_worker must be >= 1; got "
+                f"{self.inflight_per_worker}"
+            )
+
+
+@dataclass(frozen=True)
 class CompileConfig:
     """Cold-start elimination (roko_tpu/compile; docs/SERVING.md
     "Cold start & compile cache"): persistent XLA compilation cache,
@@ -618,6 +672,7 @@ class RokoConfig:
     serve: ServeConfig = field(default_factory=ServeConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     pipeline: PipelineConfig = field(default_factory=PipelineConfig)
+    distpolish: DistPolishConfig = field(default_factory=DistPolishConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)
@@ -643,6 +698,7 @@ class RokoConfig:
             }),
             fleet=FleetConfig(**raw.get("fleet", {})),
             pipeline=PipelineConfig(**raw.get("pipeline", {})),
+            distpolish=DistPolishConfig(**raw.get("distpolish", {})),
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             compile=CompileConfig(**raw.get("compile", {})),
             guard=GuardConfig(**raw.get("guard", {})),

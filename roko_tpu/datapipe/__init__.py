@@ -19,6 +19,10 @@ the device train step (docs/TRAINING.md "Sharded input pipeline"):
   the training loop consumes (single-host and dp-mesh pods), with a
   sample-granular checkpointable iterator (``state()``/``restore``)
   wired into the checkpoint ``data_state``.
+- ``io.py`` — the pluggable input opener behind every span read
+  (ROADMAP item 5a): fsspec-style ``opener(path, mode)`` signature,
+  local-path (+ ``file://``) default, ``register_opener`` for remote
+  schemes — object-storage input is one registered adapter away.
 
 The two legacy datasets (``training/data.py`` InMemoryDataset,
 ``training/lazy_data.py`` StreamingDataset) keep their public paths but
@@ -27,6 +31,7 @@ delegate ``batches(..., skip_batches=)`` to this engine.
 
 from roko_tpu.datapipe.dataset import CheckpointableIterator, ShardedDataset
 from roko_tpu.datapipe.engine import ReadStats, epoch_schedule, iter_span_batches
+from roko_tpu.datapipe.io import open_input, register_opener
 from roko_tpu.datapipe.manifest import (
     MANIFEST_BASENAME,
     Manifest,
@@ -49,5 +54,7 @@ __all__ = [
     "ManifestMismatch",
     "build_manifest",
     "load_or_build_manifest",
+    "open_input",
+    "register_opener",
     "resolve_file_set",
 ]

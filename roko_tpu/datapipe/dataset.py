@@ -50,6 +50,7 @@ class ShardedDataset:
         log=None,
         manifest: Optional[Manifest] = None,
         paths: Optional[List[str]] = None,
+        opener=None,
     ) -> None:
         if not 0 <= shard_id < num_shards:
             raise ValueError(
@@ -71,6 +72,10 @@ class ShardedDataset:
             paths = [by_name[fe.name] for fe in manifest.files]
         self.manifest = manifest
         self.paths: List[str] = list(paths or [])
+        #: fsspec-style ``opener(path, mode) -> file-like`` behind every
+        #: span read (the ROADMAP 5a remote-input seam, datapipe/io.py);
+        #: None = local paths / the process-wide scheme registry
+        self._opener = opener
         self.num_shards = num_shards
         self.shard_id = shard_id
         self.seed = seed
@@ -89,11 +94,11 @@ class ShardedDataset:
         """Load every (file, group) into host RAM once (the --memory
         path). The stream stays byte-identical to the disk-backed one:
         both read through the same span plan."""
-        import h5py
+        from roko_tpu.datapipe.io import open_h5
 
         arrays: Dict[Tuple[int, str], Tuple[np.ndarray, np.ndarray]] = {}
         for fi, p in enumerate(self.paths):
-            with h5py.File(p, "r") as fd:
+            with open_h5(p, opener=self._opener) as fd:
                 for g, _rows in self.manifest.files[fi].groups:
                     x = np.ascontiguousarray(fd[g]["examples"][()])
                     y = np.ascontiguousarray(fd[g]["labels"][()], np.int32)
@@ -184,7 +189,7 @@ class ShardedDataset:
             min_batches = self.steps_per_epoch(
                 batch_size, drop_remainder=drop_remainder
             ) - start // batch_size
-        import h5py
+        from roko_tpu.datapipe.io import open_h5
 
         fds: dict = {}
 
@@ -196,8 +201,12 @@ class ShardedDataset:
                 return x[sel], y[sel]
             fd = fds.get(span.file_idx)
             if fd is None:
-                fd = fds[span.file_idx] = h5py.File(
-                    self.paths[span.file_idx], "r"
+                # the one opener seam behind every span read
+                # (datapipe/io.py): local paths keep the direct h5py
+                # fast path; remote schemes are one registered adapter
+                # away (ROADMAP 5a)
+                fd = fds[span.file_idx] = open_h5(
+                    self.paths[span.file_idx], opener=self._opener
                 )
             g = fd[span.group]
             lo, hi = span.start, span.start + span.count

@@ -1,0 +1,108 @@
+"""Pluggable input opener behind the datapipe's span reads — the
+ROADMAP item 5(a) seam.
+
+The manifest's span reader historically opened LOCAL paths only
+(``h5py.File(path)``); streaming a corpus from object storage — the
+t5x/seqio posture (PAPERS.md) — needs exactly one indirection: an
+fsspec-style ``opener(path, mode) -> file-like``. This module is that
+indirection, deliberately tiny:
+
+- :func:`open_input` resolves a path to a binary file-like object:
+  plain paths and ``file://`` URLs open locally by default; other
+  schemes resolve through the opener registry;
+- :func:`register_opener` installs a scheme handler process-wide
+  (``register_opener("gs", fsspec_open)`` is the whole remote-input
+  adapter once an fsspec-like client exists in the image — nothing
+  else in the data plane changes);
+- :class:`ShardedDataset` accepts a per-dataset ``opener=`` override
+  (tests inject a counting ``file://`` shim through it).
+
+No new dependencies: the default opener is ``open``. The container
+image has no fsspec; remote schemes refuse loudly until an adapter is
+registered.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Callable, Dict, Optional
+
+#: fsspec-style opener signature: ``opener(path, mode) -> file-like``
+Opener = Callable[[str, str], BinaryIO]
+
+#: process-wide scheme registry (``register_opener``); ``file`` and
+#: scheme-less paths never consult it
+_OPENERS: Dict[str, Opener] = {}
+
+
+def path_scheme(path: str) -> str:
+    """The URL scheme of ``path`` (empty for plain local paths).
+    Windows drive letters would false-positive on ``:`` alone, so the
+    marker is the full ``://``."""
+    head, sep, _ = path.partition("://")
+    return head.lower() if sep else ""
+
+
+def strip_file_scheme(path: str) -> str:
+    """``file:///x`` / ``file://x`` -> a plain local path."""
+    if path_scheme(path) != "file":
+        return path
+    rest = path.split("://", 1)[1]
+    # file:///abs/path carries an empty authority; keep the leading /
+    return rest if not rest.startswith("/") else "/" + rest.lstrip("/")
+
+
+def local_open(path: str, mode: str = "rb") -> BinaryIO:
+    """The default opener: the local filesystem (``file://`` accepted)."""
+    return open(strip_file_scheme(path), mode)
+
+
+def register_opener(scheme: str, opener: Optional[Opener]) -> None:
+    """Install (or with ``None`` remove) the process-wide opener for
+    ``scheme`` — e.g. ``register_opener("gs", ...)`` to stream corpora
+    from object storage. ``file`` / scheme-less paths are not
+    overridable: local reads must stay local."""
+    scheme = scheme.lower()
+    if scheme in ("", "file"):
+        raise ValueError(
+            "local paths always open through the default opener; "
+            f"cannot register scheme {scheme!r}"
+        )
+    if opener is None:
+        _OPENERS.pop(scheme, None)
+    else:
+        _OPENERS[scheme] = opener
+
+
+def open_input(
+    path: str, mode: str = "rb", *, opener: Optional[Opener] = None
+) -> BinaryIO:
+    """Open ``path`` for reading through the seam: an explicit
+    ``opener`` wins, then the scheme registry, then the local default.
+    An unregistered remote scheme refuses with the fix in the message
+    instead of a bare ``FileNotFoundError`` on a URL-shaped path."""
+    if opener is not None:
+        return opener(path, mode)
+    scheme = path_scheme(path)
+    if scheme in ("", "file"):
+        return local_open(path, mode)
+    handler = _OPENERS.get(scheme)
+    if handler is None:
+        raise ValueError(
+            f"no input opener registered for scheme {scheme!r} "
+            f"({path!r}); call roko_tpu.datapipe.register_opener"
+            f"({scheme!r}, opener) with an fsspec-style "
+            "opener(path, mode) -> file-like"
+        )
+    return handler(path, mode)
+
+
+def open_h5(path: str, *, opener: Optional[Opener] = None):
+    """Open one corpus HDF5 through the seam. Plain local paths with no
+    explicit opener keep the direct ``h5py.File(path)`` fast path
+    (mmap-friendly); everything else goes through :func:`open_input`
+    and h5py's file-like driver."""
+    import h5py
+
+    if opener is None and path_scheme(path) == "":
+        return h5py.File(path, "r")
+    return h5py.File(open_input(path, opener=opener), "r")

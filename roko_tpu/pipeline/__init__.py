@@ -1,4 +1,32 @@
-"""Streaming polish pipeline: extraction, batching, and device
-inference as one overlapped pipeline (docs/PIPELINE.md)."""
+"""Streaming + distributed polish pipelines: extraction, batching, and
+device inference as one overlapped pipeline (docs/PIPELINE.md), and the
+fleet-distributed map-reduce tier over the same code path
+(docs/PIPELINE.md "Distributed polish").
 
-from roko_tpu.pipeline.stream import run_streaming_polish  # noqa: F401
+Exports resolve lazily (PEP 562): ``stream`` pulls the jax-backed serve
+session at import, and the fleet SUPERVISOR process — which wires the
+``POST /job`` surface through :mod:`roko_tpu.pipeline.distpolish` —
+must never pay (or risk) a jax import just to spawn workers.
+"""
+
+_EXPORTS = {
+    "run_streaming_polish": ("roko_tpu.pipeline.stream",
+                             "run_streaming_polish"),
+    "run_distributed_polish": ("roko_tpu.pipeline.distpolish",
+                               "run_distributed_polish"),
+    "PoisonedUnit": ("roko_tpu.pipeline.distpolish", "PoisonedUnit"),
+}
+
+__all__ = list(_EXPORTS)
+
+
+def __getattr__(name: str):
+    try:
+        mod, attr = _EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        ) from None
+    import importlib
+
+    return getattr(importlib.import_module(mod), attr)

@@ -15,7 +15,17 @@ output so ``roko-tpu polish --resume`` recomputes only what is missing:
                         (tmp file + fsync + ``os.replace``);
     ``manifest.jsonl``  one line per committed contig
                         ``{"contig", "file", "windows"}``, appended and
-                        fsync'd only AFTER its ``.seq`` landed.
+                        fsync'd only AFTER its ``.seq`` landed;
+    ``units.jsonl``     the distributed-polish unit ledger (one event
+                        per line: attempt / commit / quarantine, with
+                        attempt counts and worker ids), written by the
+                        ``polish --distributed`` coordinator
+                        (roko_tpu/pipeline/distpolish.py);
+    ``unit-<sha1>.npz`` a committed SPAN unit's raw predictions
+                        (positions + preds), written atomically BEFORE
+                        its ledger commit line — a resumed coordinator
+                        re-stitches giant contigs from these instead of
+                        re-running the units.
 
 Commit order makes the journal crash-consistent at every byte: a torn
 trailing manifest line (the crash hit mid-append) fails to parse and is
@@ -59,7 +69,9 @@ class PolishJournal:
         self.dir = out_path + ".resume"
         self.meta_path = os.path.join(self.dir, "meta.json")
         self.manifest_path = os.path.join(self.dir, "manifest.jsonl")
+        self.units_path = os.path.join(self.dir, "units.jsonl")
         self._manifest_fh = None
+        self._units_fh = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -145,10 +157,110 @@ class PolishJournal:
         self._manifest_fh.flush()
         os.fsync(self._manifest_fh.fileno())
 
+    # -- unit ledger (distributed polish) -----------------------------------
+
+    def unit_event(
+        self, uid: str, event: str, *, durable: bool = False, **fields
+    ) -> None:
+        """Append one ledger event for work unit ``uid``. ``durable``
+        fsyncs (commits must survive a power cut; attempt bookkeeping
+        is best-effort — a torn trailing line is skipped on load)."""
+        if self._units_fh is None:
+            self._units_fh = open(self.units_path, "a")
+        line = json.dumps(dict({"unit": uid, "event": event}, **fields))
+        self._units_fh.write(line + "\n")
+        self._units_fh.flush()
+        if durable:
+            os.fsync(self._units_fh.fileno())
+
+    def commit_unit(
+        self,
+        uid: str,
+        windows: int,
+        *,
+        positions=None,
+        preds=None,
+        worker=None,
+    ) -> None:
+        """Durably record one finished work unit. Span units carry
+        their prediction payload (``positions``/``preds`` arrays,
+        written as an atomic ``.npz`` BEFORE the ledger line — the
+        ledger never references bytes not fully on disk) so a resumed
+        coordinator re-stitches the contig without re-running them."""
+        fields = {"windows": int(windows)}
+        if worker is not None:
+            fields["worker"] = worker
+        if positions is not None:
+            import numpy as np
+
+            fname = "unit-" + hashlib.sha1(uid.encode()).hexdigest() + ".npz"
+            path = os.path.join(self.dir, fname)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                np.savez(fh, positions=positions, preds=preds)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+            fields["file"] = fname
+        self.unit_event(uid, "commit", durable=True, **fields)
+
+    def load_units(self) -> Dict[str, Dict]:
+        """Fold the unit ledger into latest-state records:
+        ``{uid: {"state", "attempts", "windows", "file", ...}}``.
+        Torn or unparseable lines are skipped (crash-consistency rule
+        shared with the contig manifest). Quarantine is informational —
+        a resumed run retries quarantined units with a fresh attempt
+        budget (the operator fixed something, or wants the loud failure
+        again)."""
+        out: Dict[str, Dict] = {}
+        with contextlib.suppress(OSError):
+            with open(self.units_path) as fh:
+                for line in fh:
+                    try:
+                        rec = json.loads(line)
+                        uid, event = rec["unit"], rec["event"]
+                    except (ValueError, KeyError, TypeError):
+                        continue  # torn trailing append
+                    cur = out.setdefault(uid, {"state": "pending"})
+                    if event == "attempt":
+                        cur["attempts"] = int(rec.get("attempts", 0))
+                    elif event == "commit":
+                        cur["state"] = "committed"
+                        cur["windows"] = int(rec.get("windows", 0))
+                        if rec.get("file"):
+                            cur["file"] = rec["file"]
+                    elif event == "quarantine":
+                        cur["state"] = "quarantined"
+        return out
+
+    def load_unit_preds(self, rec: Dict):
+        """The committed span-unit payload referenced by a
+        :meth:`load_units` record, or ``None`` when the ``.npz`` is
+        missing/unreadable (the unit then simply re-runs — a vanished
+        payload must degrade to recompute, never to a corrupt FASTA)."""
+        fname = rec.get("file")
+        if not fname:
+            return None
+        import zipfile
+
+        import numpy as np
+
+        try:
+            with np.load(os.path.join(self.dir, fname)) as z:
+                return z["positions"], z["preds"]
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile):
+            # np.load surfaces a truncated/corrupt .npz as BadZipFile
+            # or EOFError, not just OSError/ValueError
+            return None
+
     def close(self) -> None:
         if self._manifest_fh is not None:
             self._manifest_fh.close()
             self._manifest_fh = None
+        if self._units_fh is not None:
+            self._units_fh.close()
+            self._units_fh = None
 
     def finalize(self) -> None:
         """The run completed and the FASTA is whole: the journal has

@@ -60,15 +60,22 @@ class RetryPolicy:
         on_retry: Optional[OnRetry] = None,
         retry_after: Optional[Callable[[BaseException], Optional[float]]] = None,
         sleep: Callable[[float], None] = time.sleep,
+        giveup: Optional[Callable[[BaseException], bool]] = None,
     ) -> object:
         """Run ``fn`` with this policy. ``on_retry(failures, exc,
         delay)`` fires before each retry; ``retry_after(exc)`` may
-        return a protocol-demanded minimum delay for that failure."""
+        return a protocol-demanded minimum delay for that failure.
+        ``giveup(exc)`` returning True propagates that failure
+        immediately even when its type is retryable — for protocol
+        states where retrying is actively wrong (a draining fleet asks
+        callers to PARK work, not hammer the budget against it)."""
         failures = 0
         while True:
             try:
                 return fn()
             except self.retryable as e:
+                if giveup is not None and giveup(e):
+                    raise
                 failures += 1
                 if failures >= self.max_attempts:
                     raise

@@ -36,7 +36,12 @@ Modules:
 """
 
 from roko_tpu.serve.batcher import Backpressure, MicroBatcher
-from roko_tpu.serve.client import PolishClient, ServerBusy, ServiceUnavailable
+from roko_tpu.serve.client import (
+    FleetDraining,
+    PolishClient,
+    ServerBusy,
+    ServiceUnavailable,
+)
 from roko_tpu.serve.fleet import Fleet, WorkerHandle, WorkerLaunchSpec
 from roko_tpu.serve.metrics import ServeMetrics
 from roko_tpu.serve.registry import (
@@ -60,6 +65,7 @@ __all__ = [
     "Backpressure",
     "ContinuousBatcher",
     "Fleet",
+    "FleetDraining",
     "MicroBatcher",
     "PolishClient",
     "PolishSession",

@@ -34,6 +34,16 @@ class ServerBusy(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class FleetDraining(ServerBusy):
+    """503 with a DRAINING status: the fleet (or worker) is
+    deliberately shedding all new work for a rollout/shutdown window —
+    not transient queue pressure. Typed so callers PARK work and
+    re-submit later instead of burning a retry budget against a wait
+    that outlasts it (the distributed-polish coordinator parks its
+    units on exactly this signal); :meth:`PolishClient.polish`
+    propagates it immediately rather than retrying."""
+
+
 class ServiceUnavailable(ServerBusy):
     """The retry budget was exhausted against 503s: every one of
     ``attempts`` tries was shed (queue full, breaker open, fleet
@@ -50,6 +60,23 @@ class ServiceUnavailable(ServerBusy):
         )
         self.retry_after_s = retry_after_s
         self.attempts = attempts
+
+
+def parse_503_body(body) -> "tuple[str, float]":
+    """``(error_detail, retry_after_s)`` from a 503 reply body,
+    tolerant of ANY malformation — detail parses FIRST so a junk
+    ``retry_after_s`` never costs the draining classification; the
+    wait falls back to 1.0. Shared by this client and the
+    distributed-polish coordinator's dispatch loop so the two 503
+    classifiers cannot drift."""
+    detail, retry = "", 1.0
+    try:
+        parsed = json.loads(body)
+        detail = str(parsed.get("error", ""))
+        retry = float(parsed.get("retry_after_s", 1.0))
+    except (ValueError, AttributeError, TypeError, UnicodeDecodeError):
+        pass
+    return detail, retry
 
 
 def _b64(arr: np.ndarray, dtype) -> str:
@@ -93,10 +120,12 @@ class PolishClient:
         except urllib.error.HTTPError as e:
             body = e.read()
             if e.code == 503:
-                try:
-                    retry = float(json.loads(body).get("retry_after_s", 1.0))
-                except (ValueError, AttributeError):
-                    retry = 1.0
+                detail, retry = parse_503_body(body)
+                if "draining" in detail:
+                    # the server names a deliberate drain window
+                    # (healthz=draining): typed, so callers can park
+                    # instead of retrying into the drain
+                    raise FleetDraining(retry) from None
                 raise ServerBusy(retry) from None
             try:
                 detail = json.loads(body).get("error", "")
@@ -150,9 +179,12 @@ class PolishClient:
                     ),
                     retry_after=lambda e: getattr(e, "retry_after_s", None),
                     sleep=self._sleep,
+                    # a draining fleet asks callers to PARK, not retry:
+                    # propagate the typed signal with the budget intact
+                    giveup=lambda e: isinstance(e, FleetDraining),
                 )
             )
-        except ServiceUnavailable:
+        except (ServiceUnavailable, FleetDraining):
             raise
         except ServerBusy as e:
             raise ServiceUnavailable(e.retry_after_s, retries + 1) from e

@@ -245,6 +245,10 @@ class Fleet:
         #: the live RolloutController when a rollout is running or has
         #: run (supervisor wires it; metrics render its state)
         self.rollout = None
+        #: the live (or last) DistPolishJob when a distributed polish
+        #: runs over this fleet (pipeline/distpolish.py; GET /jobz
+        #: renders its snapshot)
+        self.job = None
         self._log = log
         self._clock = clock
         self.runtime_dir = (
@@ -653,6 +657,16 @@ class Fleet:
             ]
         return max(hints) if hints else self.cfg.serve.retry_after_s
 
+    def suspect(self, w: WorkerHandle) -> None:
+        """A worker that dropped a connection leaves rotation NOW; the
+        supervision loop confirms via waitpid/heartbeat and either
+        restarts it or probes it straight back to READY. Shared by the
+        front end's failover path and the distributed-polish
+        coordinator."""
+        with self._lock:
+            if w.state == READY:
+                w.state = UNHEALTHY
+
     def pick(
         self, exclude: Sequence[int] = ()
     ) -> Optional[Tuple[WorkerHandle, int]]:
@@ -732,9 +746,7 @@ class Fleet:
                         request_id=request_id, worker=w.id,
                         error=type(e).__name__,
                     )
-                with self._lock:
-                    if w.state == READY:
-                        w.state = UNHEALTHY
+                self.suspect(w)
                 continue
             if code == 503:
                 if retry_after is None:

@@ -16,6 +16,9 @@ Routes (payload schema: docs/SERVING.md):
   2. **extractor convenience**: ``ref`` + ``bam`` (server-local paths)
      — runs the ``features.pipeline`` extractor on the BAM and polishes
      every contig. Returns ``{"contigs": {name: polished}}``.
+  3. **work unit** (the distributed-polish tier): ``ref`` + ``bam`` +
+     ``unit`` — extract and polish exactly one coordinator-named
+     region slice (docs/PIPELINE.md "Distributed polish").
 
 - ``GET /healthz`` — liveness + the compiled ladder. Goes **503** while
   the ladder is still warming (status ``"warming"`` — the socket binds
@@ -181,6 +184,17 @@ def _polish_windows(
     return {"contig": contig, "polished": polished, "windows": n}
 
 
+def path_under_root(path: str, root: str) -> bool:
+    """THE data-root containment rule (realpath-resolved): shared by
+    the /polish path validation below and the supervisor's POST /job
+    ``out`` check, so a hardening here covers every client-named
+    server-side path."""
+    import os
+
+    real, rootr = os.path.realpath(path), os.path.realpath(root)
+    return real == rootr or real.startswith(rootr + os.sep)
+
+
 def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
     """Validate a client-named server-local path. ONE error message for
     every failure mode (bad type, outside the root, missing): the reply
@@ -193,11 +207,9 @@ def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
     )
     if not isinstance(path, str) or not path:
         raise err
+    if data_root is not None and not path_under_root(path, data_root):
+        raise err
     real = os.path.realpath(path)
-    if data_root is not None:
-        root = os.path.realpath(data_root)
-        if real != root and not real.startswith(root + os.sep):
-            raise err
     if not os.path.isfile(real):
         raise err
     return real
@@ -252,6 +264,87 @@ def _polish_bam(
         if trace is not None:
             trace.add("stitch", time.perf_counter() - t0)
     return {"contigs": contigs, "windows": n}
+
+
+def _polish_unit(
+    batcher: MicroBatcher, payload: Dict[str, Any],
+    data_root: Optional[str] = None,
+    trace: Optional[RequestTrace] = None,
+) -> Dict[str, Any]:
+    """Worker-side execution of ONE distributed-polish work unit
+    (roko_tpu/pipeline/distpolish.py, docs/PIPELINE.md "Distributed
+    polish"): extract exactly the unit's region slice from server-local
+    ``ref``+``bam``, predict over the warm session, and either stitch
+    the contig (``emit: "contig"`` — whole-contig units) or return the
+    raw per-window predictions (``emit: "preds"`` — span units of a
+    giant contig, voted and stitched coordinator-side). The region
+    table and seeds re-derive deterministically, so the windows are
+    bit-identical to a single-process run's."""
+    from roko_tpu.pipeline.distpolish import (
+        b64_array,
+        extract_unit_windows,
+    )
+
+    ref = _check_data_path("ref", payload.get("ref"), data_root)
+    bam = _check_data_path("bam", payload.get("bam"), data_root)
+    unit = payload.get("unit")
+    if not isinstance(unit, dict):
+        raise _BadRequest("field 'unit' must be an object")
+    try:
+        contig = unit["contig"]
+        first = int(unit["first_region"])
+        count = int(unit["n_regions"])
+    except (KeyError, TypeError, ValueError):
+        raise _BadRequest(
+            "field 'unit' needs 'contig', 'first_region', 'n_regions'"
+        ) from None
+    if not isinstance(contig, str) or not contig:
+        raise _BadRequest("'unit.contig' must be a contig name")
+    emit = unit.get("emit", "contig")
+    if emit not in ("contig", "preds"):
+        raise _BadRequest("'unit.emit' must be 'contig' or 'preds'")
+    try:
+        seed = int(payload.get("seed", 0))
+    except (TypeError, ValueError):
+        raise _BadRequest("'seed' must be an integer") from None
+    session = batcher.session
+    t0 = time.perf_counter()
+    try:
+        draft, positions, x = extract_unit_windows(
+            ref, bam, contig, first, count, seed, session.cfg
+        )
+    except ValueError as e:
+        raise _BadRequest(str(e)) from None
+    if trace is not None:
+        trace.add("extract", time.perf_counter() - t0)
+    n = int(len(positions))
+    # chunk at the top ladder rung so one giant unit never exceeds the
+    # batching plane's admission bounds (the _polish_bam rule)
+    top = session.ladder[-1]
+    chunks = [
+        batcher.predict(x[i:i + top], timeout=REQUEST_TIMEOUT_S, trace=trace)
+        for i in range(0, n, top)
+    ]
+    preds = (
+        np.concatenate(chunks)
+        if chunks
+        else np.empty((0, session.cfg.model.window_cols), np.int32)
+    )
+    if emit == "preds":
+        return {
+            "contig": contig,
+            "windows": n,
+            "positions": b64_array(positions, np.int64),
+            "preds": b64_array(preds, np.int32),
+        }
+    t0 = time.perf_counter()
+    board = VoteBoard({contig: draft})
+    if n:
+        board.add([contig] * n, positions, preds)
+    polished = board.stitch(contig)
+    if trace is not None:
+        trace.add("stitch", time.perf_counter() - t0)
+    return {"contig": contig, "polished": polished, "windows": n}
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -553,7 +646,11 @@ class _Handler(JsonRequestHandler):
             payload = json.loads(raw.decode())
             if not isinstance(payload, dict):
                 raise _BadRequest("payload must be a JSON object")
-            if "bam" in payload:
+            if "unit" in payload:
+                result = _polish_unit(
+                    self.batcher, payload, self.data_root, trace=trace
+                )
+            elif "bam" in payload:
                 result = _polish_bam(
                     self.batcher, payload, self.data_root, trace=trace
                 )

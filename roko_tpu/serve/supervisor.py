@@ -109,15 +109,27 @@ class _FrontHandler(JsonRequestHandler):
             self._reply_json(
                 200, ctl.status() if ctl is not None else {"state": "idle"}
             )
+        elif self.path == "/jobz":
+            # distributed-polish job status: per-unit state table
+            # (docs/PIPELINE.md "Distributed polish")
+            job = getattr(self.fleet, "job", None)
+            self._reply_json(
+                200, job.snapshot() if job is not None else {"state": "idle"}
+            )
         else:
             self._reply_json(404, {"error": f"no route {self.path}"})
 
-    def _handle_rollout(self) -> None:
-        starter = getattr(self.server, "_start_rollout", None)
+    def _handle_starter(self, attr: str, what: str) -> None:
+        """Shared POST plumbing for the operator surfaces whose
+        implementation run_supervisor wires onto the server object
+        (``/rollout`` and ``/job``): 501 when unconfigured, bounded
+        body read, JSON-object validation, then ``(code, body)`` from
+        the starter."""
+        starter = getattr(self.server, attr, None)
         if starter is None:
             self._reply_json(
                 501,
-                {"error": "rollout is not configured on this front end "
+                {"error": f"{what} is not configured on this front end "
                           "(run via `roko-tpu serve --workers N`)"},
             )
             return
@@ -136,7 +148,15 @@ class _FrontHandler(JsonRequestHandler):
 
     def do_POST(self) -> None:  # noqa: N802 - http.server API
         if self.path == "/rollout":
-            self._handle_rollout()
+            self._handle_starter("_start_rollout", "rollout")
+            return
+        if self.path == "/job":
+            # submit a whole-genome distributed polish (server-side
+            # ref/bam/out paths) over THIS fleet (docs/PIPELINE.md
+            # "Distributed polish"); observe with GET /jobz
+            self._handle_starter(
+                "_start_job", "distributed polish jobs"
+            )
             return
         if self.path != "/polish":
             self._reply_json(404, {"error": f"no route {self.path}"})
@@ -219,6 +239,9 @@ def make_front_server(
     #: POST /rollout implementation; run_supervisor wires the real one
     #: (needs the registry + journal), bare front ends answer 501
     server._start_rollout = None  # type: ignore[attr-defined]
+    #: POST /job implementation (distributed polish); run_supervisor
+    #: wires it, bare front ends answer 501
+    server._start_job = None  # type: ignore[attr-defined]
     init_lifecycle(server, fleet.cfg.resilience.drain_deadline_s)
     return server
 
@@ -345,6 +368,19 @@ def make_rollout_starter(
                 return 409, {
                     "error": "a rollout is already in progress",
                     "status": ctl.status(),
+                }
+            job = getattr(fleet, "job", None)
+            if job is not None and job.active():
+                # a rollout mid-job would splice two versions' contigs
+                # into one rc-0 FASTA — the exact mix the distributed
+                # journal identity exists to refuse (docs/PIPELINE.md
+                # "Distributed polish"); the job side refuses the
+                # mirror-image race
+                return 409, {
+                    "error": "a distributed polish job is running; "
+                             "refusing to roll worker versions "
+                             "underneath it",
+                    "job": job.snapshot(),
                 }
             if fleet.active_version == name:
                 return 409, {
@@ -486,6 +522,15 @@ def run_supervisor(
     # (a recovered/pinned version, not necessarily the CLI args)
     server._start_rollout = make_rollout_starter(  # type: ignore[attr-defined]
         fleet, journal, boot_model, boot_cfg, log=log
+    )
+    # distributed-polish jobs over this fleet (POST /job + GET /jobz;
+    # docs/PIPELINE.md "Distributed polish") — lazy import: the job
+    # starter pulls the pipeline package, which the bare serving path
+    # never needs
+    from roko_tpu.pipeline.distpolish import make_job_starter
+
+    server._start_job = make_job_starter(  # type: ignore[attr-defined]
+        fleet, boot_cfg, log=log
     )
     if announce:
         write_announce(announce, server.server_address[1])

@@ -1,0 +1,951 @@
+"""Distributed polish tests (roko_tpu/pipeline/distpolish.py,
+docs/PIPELINE.md "Distributed polish").
+
+Tier-1 coverage drives the REAL coordinator state machine — unit
+splitting, dispatch/exclusion/retry, poison-unit quarantine, draining
+parks, journal-ledger resume, identity refusals — against a fake fleet
+and a fake transport (no processes, no HTTP), plus one in-process
+end-to-end: the coordinator + the real worker-side unit executor over a
+warm session must produce a FASTA byte-identical to single-process
+streaming polish, including span-split giant contigs merged
+coordinator-side. The real 2-worker SIGKILL acceptance lives in
+tests/test_fault_injection.py (CI ``dist-polish`` lane).
+"""
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from roko_tpu import constants as C
+from roko_tpu.config import (
+    DistPolishConfig,
+    MeshConfig,
+    ModelConfig,
+    RegionConfig,
+    RokoConfig,
+    ServeConfig,
+)
+from roko_tpu.features.pipeline import generate_regions
+from roko_tpu.io.fasta import read_fasta, write_fasta
+from roko_tpu.pipeline.distpolish import (
+    DistPolishJob,
+    PoisonedUnit,
+    _run_job_core,
+    b64_array,
+    distributed_meta,
+    make_job_starter,
+    split_units,
+)
+from roko_tpu.resilience import JournalMismatch, PolishJournal
+
+from .helpers import random_seq
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+REGION = RegionConfig(size=1200, overlap=100)
+
+#: fast coordinator knobs: no multi-second parks in unit tests
+FAST = DistPolishConfig(
+    unit_bases=0, unit_attempts=2, park_poll_s=0.01, ready_timeout_s=5.0,
+)
+
+
+def _quiet(*_a, **_k):
+    pass
+
+
+class FakeWorker:
+    def __init__(self, wid):
+        self.id = wid
+        self.state = "ready"
+        self.port = 9000 + wid
+
+
+class FakeFleet:
+    """The narrow surface DistPolishJob consumes: pick / ready_count /
+    workers / _draining — same round-robin-with-exclusions contract as
+    the real Fleet."""
+
+    def __init__(self, n=2):
+        self.workers = [FakeWorker(i) for i in range(n)]
+        self._draining = False
+        self._rr = 0
+        self.job = None
+
+    def ready_count(self):
+        return sum(1 for w in self.workers if w.state == "ready")
+
+    def pick(self, exclude=()):
+        ready = [
+            w for w in self.workers
+            if w.state == "ready" and w.id not in exclude
+        ]
+        if not ready:
+            return None
+        self._rr += 1
+        w = ready[self._rr % len(ready)]
+        return w, w.port
+
+
+def _refs(*specs):
+    """[(name, draft)] with deterministic sequences."""
+    import random
+
+    rng = random.Random(3)
+    return [(name, random_seq(rng, n)) for name, n in specs]
+
+
+def _cfg(**dist_kw):
+    return RokoConfig(
+        model=TINY,
+        region=REGION,
+        distpolish=dataclasses.replace(FAST, **dist_kw),
+    )
+
+
+def _polished_reply(payload):
+    """Fake whole-contig worker reply, deterministic per contig."""
+    contig = payload["unit"]["contig"]
+    return 200, json.dumps(
+        {"contig": contig, "polished": f"POLISHED-{contig}",
+         "windows": 3}
+    ).encode()
+
+
+def _job(fleet, cfg, refs, transport, journal=None, writer=None,
+         committed=None):
+    units = [
+        u for u in split_units(refs, cfg.region, cfg.distpolish.unit_bases)
+        if u.contig not in (committed or {})
+    ]
+    return DistPolishJob(
+        fleet, cfg,
+        ref="draft.fa", bam="reads.bam", seed=0,
+        refs=refs, units=units,
+        journal=journal, writer=writer, committed=committed,
+        transport=transport, log=_quiet,
+    )
+
+
+# -- unit splitting -----------------------------------------------------------
+
+
+def test_split_units_whole_contigs_by_default():
+    refs = _refs(("zulu", 3000), ("alpha", 900), ("empty", 0))
+    units = split_units(refs, REGION, 0)
+    by = {u.contig: u for u in units}
+    assert len(units) == 3
+    assert by["zulu"].whole and by["zulu"].n_regions == 3
+    assert by["alpha"].whole and by["alpha"].n_regions == 1
+    assert by["empty"].n_regions == 0  # zero-length: local passthrough
+
+
+def test_split_units_span_splits_on_region_table():
+    refs = _refs(("giant", 3000), ("small", 900))
+    units = split_units(refs, REGION, 1500)
+    giant = [u for u in units if u.contig == "giant"]
+    small = [u for u in units if u.contig == "small"]
+    # regions of a 3000-base contig at size=1200/overlap=100:
+    # [0,1200) [1100,2300) [2200,3000) — each alone under 1500
+    assert [
+        (u.first_region, u.n_regions, u.start, u.end) for u in giant
+    ] == [(0, 1, 0, 1200), (1, 1, 1100, 2300), (2, 1, 2200, 3000)]
+    assert not any(u.whole for u in giant)
+    assert len(small) == 1 and small[0].whole
+    # the units' region slices tile the full region table exactly once
+    regions = list(generate_regions(3000, "giant", REGION))
+    covered = sorted(
+        i for u in giant
+        for i in range(u.first_region, u.first_region + u.n_regions)
+    )
+    assert covered == list(range(len(regions)))
+
+
+def test_split_units_uid_stable_across_runs():
+    refs = _refs(("g", 5000))
+    a = [u.uid for u in split_units(refs, REGION, 1500)]
+    b = [u.uid for u in split_units(refs, REGION, 1500)]
+    assert a == b  # resume matches ledger records by uid
+
+
+# -- journal unit ledger ------------------------------------------------------
+
+
+def test_unit_ledger_roundtrip_and_torn_line(tmp_path):
+    out = str(tmp_path / "out.fa")
+    j = PolishJournal(out)
+    j.open({"x": 1}, resume=False)
+    j.unit_event("c@0+1", "attempt", attempts=1, worker=0)
+    j.unit_event("c@0+1", "attempt", attempts=2, worker=1)
+    j.commit_unit("c@0+1", 7)
+    j.unit_event("d@0+2", "quarantine", durable=True, attempts=3,
+                 error="boom")
+    j.close()
+    # torn trailing append must be skipped, not crash the load
+    with open(j.units_path, "a") as fh:
+        fh.write('{"unit": "e@0+1", "ev')
+    j2 = PolishJournal(out)
+    j2.open({"x": 1}, resume=True)
+    units = j2.load_units()
+    j2.close()
+    assert units["c@0+1"]["state"] == "committed"
+    assert units["c@0+1"]["windows"] == 7
+    assert units["c@0+1"]["attempts"] == 2
+    assert units["d@0+2"]["state"] == "quarantined"
+    assert "e@0+1" not in units
+
+
+def test_unit_ledger_span_preds_roundtrip(tmp_path):
+    out = str(tmp_path / "out.fa")
+    j = PolishJournal(out)
+    j.open({"x": 1}, resume=False)
+    pos = np.arange(2 * 90 * 2, dtype=np.int64).reshape(2, 90, 2)
+    preds = (np.arange(2 * 90, dtype=np.int32) % 5).reshape(2, 90)
+    j.commit_unit("g@0+1", 2, positions=pos, preds=preds, worker=1)
+    rec = j.load_units()["g@0+1"]
+    loaded = j.load_unit_preds(rec)
+    j.close()
+    assert loaded is not None
+    np.testing.assert_array_equal(loaded[0], pos)
+    np.testing.assert_array_equal(loaded[1], preds)
+    # a corrupt payload (crash-torn bytes) degrades to recompute too
+    with open(os.path.join(j.dir, rec["file"]), "wb") as fh:
+        fh.write(b"PK\x03\x04 torn npz")
+    assert PolishJournal(out).load_unit_preds(rec) is None
+    with open(os.path.join(j.dir, rec["file"]), "wb"):
+        pass  # zero-byte file
+    assert PolishJournal(out).load_unit_preds(rec) is None
+    # and a vanished payload likewise (None), never a crash
+    os.unlink(os.path.join(j.dir, rec["file"]))
+    assert PolishJournal(out).load_unit_preds(rec) is None
+
+
+# -- coordinator state machine ------------------------------------------------
+
+
+def test_happy_path_commits_every_unit():
+    fleet = FakeFleet(2)
+    refs = _refs(("zulu", 3000), ("alpha", 900), ("empty", 0))
+    job = _job(fleet, _cfg(), refs, lambda p, payload, t:
+               _polished_reply(payload))
+    polished = job.run()
+    assert polished["zulu"] == "POLISHED-zulu"
+    assert polished["alpha"] == "POLISHED-alpha"
+    assert polished["empty"] == dict(refs)["empty"]  # draft passthrough
+    assert all(u.state == "committed" for u in job.units)
+    assert job.snapshot()["state"] == "done"
+    assert job.snapshot()["counts"] == {"committed": 3}
+
+
+def test_worker_death_redispatches_to_survivor_with_exclusion():
+    """A connection-level failure (the SIGKILL signature) re-dispatches
+    the unit to a DIFFERENT worker — the excluded-worker memory — and
+    costs exactly one extra dispatch."""
+    fleet = FakeFleet(2)
+    refs = _refs(("zulu", 900), ("alpha", 900))
+    calls = []
+    state = {"failed": False}
+
+    def transport(port, payload, timeout):
+        wid = port - 9000
+        contig = payload["unit"]["contig"]
+        calls.append((wid, contig))
+        if contig == "alpha" and not state["failed"]:
+            state["failed"] = True
+            raise ConnectionError("worker SIGKILLed mid-unit")
+        return _polished_reply(payload)
+
+    job = _job(fleet, _cfg(), refs, transport)
+    polished = job.run()
+    assert polished["alpha"] == "POLISHED-alpha"
+    tried = [wid for wid, contig in calls if contig == "alpha"]
+    assert len(tried) == 2 and tried[0] != tried[1]  # survivor, not ping-pong
+    alpha = next(u for u in job.units if u.contig == "alpha")
+    assert alpha.failures == 1 and alpha.state == "committed"
+
+
+def test_poison_unit_quarantined_names_contig_and_commits_rest(tmp_path):
+    """A unit failing its whole attempt budget quarantines loudly and
+    the job fails NAMING the contig — after the healthy remainder
+    committed (maximum salvage for --resume)."""
+    fleet = FakeFleet(2)
+    refs = _refs(("good", 900), ("bad", 900))
+    out = str(tmp_path / "out.fa")
+    journal = PolishJournal(out)
+    journal.open({"m": 1}, resume=False)
+
+    def transport(port, payload, timeout):
+        if payload["unit"]["contig"] == "bad":
+            raise ConnectionError("poison")
+        return _polished_reply(payload)
+
+    job = _job(fleet, _cfg(), refs, transport, journal=journal)
+    with pytest.raises(PoisonedUnit, match="'bad'"):
+        job.run()
+    assert job.snapshot()["state"] == "failed"
+    bad = next(u for u in job.units if u.contig == "bad")
+    good = next(u for u in job.units if u.contig == "good")
+    assert bad.state == "quarantined"
+    assert bad.failures == FAST.unit_attempts
+    assert good.state == "committed"
+    # durable evidence: ledger quarantine + committed contig survive
+    units = journal.load_units()
+    journal.close()
+    assert units[bad.uid]["state"] == "quarantined"
+    assert units[good.uid]["state"] == "committed"
+    j2 = PolishJournal(out)
+    committed = j2.open({"m": 1}, resume=True)
+    j2.close()
+    assert set(committed) == {"good"}
+
+
+def test_draining_fleet_parks_units_then_completes():
+    """A draining fleet parks the whole job (zero dispatches) instead
+    of burning attempts; work flows the moment the drain lifts."""
+    fleet = FakeFleet(2)
+    fleet._draining = True
+    refs = _refs(("zulu", 900),)
+    calls = []
+
+    def transport(port, payload, timeout):
+        calls.append(port)
+        return _polished_reply(payload)
+
+    job = _job(fleet, _cfg(), refs, transport)
+    t = threading.Thread(target=job.run, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert calls == []  # parked, not dispatched
+    fleet._draining = False
+    t.join(5.0)
+    assert not t.is_alive()
+    assert job.units[0].state == "committed"
+    assert job.units[0].failures == 0
+
+
+def test_worker_503_draining_parks_without_burning_attempts():
+    """A worker-side draining 503 parks the unit — no attempt burned,
+    no exclusion — and the SAME worker may serve it after the window."""
+    fleet = FakeFleet(1)
+    refs = _refs(("zulu", 900),)
+    state = {"calls": 0}
+
+    def transport(port, payload, timeout):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            return 503, json.dumps(
+                {"error": "server draining", "retry_after_s": 0.02}
+            ).encode()
+        return _polished_reply(payload)
+
+    job = _job(fleet, _cfg(), refs, transport)
+    job.run()
+    u = job.units[0]
+    assert u.state == "committed"
+    assert u.failures == 0  # parked, not failed
+    assert u.excluded == []
+    assert state["calls"] == 2
+
+
+def test_malformed_200_reply_burns_one_attempt_not_the_job():
+    """A 200 with garbage in it (null windows, non-string fields) is
+    ONE failed attempt and a re-dispatch — never a whole-job abort."""
+    fleet = FakeFleet(2)
+    refs = _refs(("zulu", 900),)
+    state = {"calls": 0}
+
+    def transport(port, payload, timeout):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            return 200, json.dumps(
+                {"contig": "zulu", "windows": None, "polished": 7}
+            ).encode()
+        return _polished_reply(payload)
+
+    job = _job(fleet, _cfg(), refs, transport)
+    polished = job.run()
+    assert polished["zulu"] == "POLISHED-zulu"
+    assert job.units[0].failures == 1
+    assert job.units[0].state == "committed"
+
+
+def test_degraded_fleet_lowers_inflight_limit():
+    fleet = FakeFleet(4)
+    cfg = _cfg()
+    job = _job(fleet, cfg, _refs(("a", 900)), lambda *a: (_ for _ in ()))
+    assert job._inflight_limit() == 2 * 4
+    fleet.workers[0].state = "dead"
+    fleet.workers[1].state = "warming"
+    assert job._inflight_limit() == 2 * 2  # degrades, doesn't fail
+    fleet._draining = True
+    assert job._inflight_limit() == 0
+
+
+# -- span units: merge + resume ----------------------------------------------
+
+
+def _span_windows(draft, region, k=4):
+    """Deterministic synthetic windows inside one region: positions on
+    the draft, ins=0, preds a pure function of position."""
+    cols = C.WINDOW_COLS
+    span = region.end - region.start
+    pos = np.zeros((k, cols, 2), np.int64)
+    for j in range(k):
+        pos[j, :, 0] = region.start + (j * 17 + np.arange(cols)) % span
+    preds = ((pos[:, :, 0] * 7 + 3) % C.NUM_CLASSES).astype(np.int32)
+    return pos, preds
+
+
+def _span_transport(refs, region_cfg):
+    """Fake worker for span units: returns the deterministic synthetic
+    predictions of exactly the unit's region slice."""
+    drafts = dict(refs)
+
+    def transport(port, payload, timeout):
+        unit = payload["unit"]
+        contig = unit["contig"]
+        if unit["emit"] == "contig":
+            return _polished_reply(payload)
+        regions = list(
+            generate_regions(len(drafts[contig]), contig, region_cfg)
+        )
+        sl = regions[
+            unit["first_region"]:unit["first_region"] + unit["n_regions"]
+        ]
+        pos = np.concatenate(
+            [_span_windows(drafts[contig], r)[0] for r in sl]
+        )
+        preds = np.concatenate(
+            [_span_windows(drafts[contig], r)[1] for r in sl]
+        )
+        return 200, json.dumps({
+            "contig": contig,
+            "windows": int(len(pos)),
+            "positions": b64_array(pos, np.int64),
+            "preds": b64_array(preds, np.int32),
+        }).encode()
+
+    return transport
+
+
+def _span_reference(refs, region_cfg, contig):
+    """ONE VoteBoard fed every region's windows — what a single process
+    accumulates; the coordinator's per-unit merge must stitch the same
+    bytes."""
+    from roko_tpu.infer import VoteBoard
+
+    drafts = dict(refs)
+    board = VoteBoard({contig: drafts[contig]})
+    for r in generate_regions(len(drafts[contig]), contig, region_cfg):
+        pos, preds = _span_windows(drafts[contig], r)
+        board.add([contig] * len(pos), pos, preds)
+    return board.stitch(contig)
+
+
+def test_span_units_merge_byte_identical_to_single_board():
+    refs = _refs(("giant", 3000),)
+    cfg = _cfg(unit_bases=1500)
+    fleet = FakeFleet(2)
+    job = _job(fleet, cfg, refs, _span_transport(refs, cfg.region))
+    polished = job.run()
+    assert len([u for u in job.units if not u.whole]) == 3
+    assert polished["giant"] == _span_reference(refs, cfg.region, "giant")
+
+
+def test_span_unit_resume_reloads_committed_preds(tmp_path):
+    """Coordinator death between span commits: the resumed job reloads
+    committed units' predictions from the journal ledger (zero re-runs)
+    and re-dispatches ONLY the missing span — stitched bytes identical
+    to an uninterrupted merge."""
+    refs = _refs(("giant", 3000),)
+    cfg = _cfg(unit_bases=1500, unit_attempts=1)
+    out = str(tmp_path / "giant.fa")
+    meta = {"m": "span"}
+
+    # run 1: the third span unit is poison — two spans commit, the
+    # contig never stitches, the journal survives
+    def failing(port, payload, timeout):
+        if payload["unit"]["first_region"] == 2:
+            raise ConnectionError("killed")
+        return _span_transport(refs, cfg.region)(port, payload, timeout)
+
+    j1 = PolishJournal(out)
+    j1.open(meta, resume=False)
+    job1 = _job(FakeFleet(2), cfg, refs, failing, journal=j1)
+    with pytest.raises(PoisonedUnit):
+        job1.run()
+    j1.close()
+
+    # run 2 (resume): only the missing span dispatches
+    dispatched = []
+
+    def healthy(port, payload, timeout):
+        dispatched.append(payload["unit"]["first_region"])
+        return _span_transport(refs, cfg.region)(port, payload, timeout)
+
+    j2 = PolishJournal(out)
+    committed = j2.open(meta, resume=True)
+    assert committed == {}  # no CONTIG committed yet — only span units
+    job2 = _job(FakeFleet(2), cfg, refs, healthy, journal=j2)
+    polished = job2.run()
+    j2.close()
+    assert dispatched == [2]
+    assert polished["giant"] == _span_reference(refs, cfg.region, "giant")
+
+
+# -- end-to-end over _run_job_core (journal + writer + resume) ---------------
+
+
+def _core(fleet, cfg, tmp_path, refs, transport, out_name, resume=False,
+          identity=None):
+    fasta = str(tmp_path / "draft.fa")
+    if not os.path.exists(fasta):
+        write_fasta(fasta, refs)
+    # a BGZF-magic stub: _ensure_bam sniffs the magic and passes real
+    # BAMs through untouched (the fake transports never open it)
+    bam = str(tmp_path / "reads.bam")
+    if not os.path.exists(bam):
+        with open(bam, "wb") as fh:
+            fh.write(b"\x1f\x8bstub")
+    out = str(tmp_path / out_name)
+    polished = _run_job_core(
+        fleet, cfg,
+        ref=fasta, bam=bam, out=out, seed=0, resume=resume,
+        model_identity=identity or {"version": "boot", "fp": "a" * 8},
+        transport=transport, log=_quiet,
+    )
+    return out, polished
+
+
+def test_job_core_writes_sorted_fasta_and_finalizes_journal(tmp_path):
+    refs = _refs(("zulu", 900), ("alpha", 900))
+    out, _ = _core(
+        FakeFleet(2), _cfg(), tmp_path, refs,
+        lambda p, payload, t: _polished_reply(payload), "out.fa",
+    )
+    assert [
+        (n, s) for n, s in read_fasta(out)
+    ] == [("alpha", "POLISHED-alpha"), ("zulu", "POLISHED-zulu")]
+    assert not os.path.isdir(out + ".resume")  # finalized
+
+
+def test_coordinator_resume_skips_committed_contigs(tmp_path):
+    """The coordinator-death contract, in-process: run 1 commits what
+    it can and fails; run 2 with resume dispatches ONLY the uncommitted
+    contig and the final FASTA is byte-identical to a clean run's."""
+    refs = _refs(("zulu", 900), ("alpha", 900), ("mike", 900))
+
+    def failing(port, payload, timeout):
+        if payload["unit"]["contig"] == "mike":
+            raise ConnectionError("coordinator died around here")
+        return _polished_reply(payload)
+
+    with pytest.raises(PoisonedUnit):
+        _core(FakeFleet(2), _cfg(), tmp_path, refs, failing, "out.fa")
+    # failed run leaves NO half FASTA, only the journal
+    assert not os.path.exists(str(tmp_path / "out.fa"))
+    assert os.path.isdir(str(tmp_path / "out.fa") + ".resume")
+
+    dispatched = []
+
+    def healthy(port, payload, timeout):
+        dispatched.append(payload["unit"]["contig"])
+        return _polished_reply(payload)
+
+    out, _ = _core(
+        FakeFleet(2), _cfg(), tmp_path, refs, healthy, "out.fa",
+        resume=True,
+    )
+    assert dispatched == ["mike"]  # zero re-runs of committed contigs
+    clean_out, _ = _core(
+        FakeFleet(2), _cfg(), tmp_path, refs, healthy, "clean.fa",
+    )
+    assert open(out, "rb").read() == open(clean_out, "rb").read()
+    assert not os.path.isdir(out + ".resume")
+
+
+def test_resume_refuses_quantize_and_version_change(tmp_path):
+    """ISSUE 15 satellite: the journal identity covers model.quantize
+    and the fleet's model version — a --resume under int8-vs-f32
+    weights or a rolled-out version refuses instead of splicing
+    mixed-precision contigs into one FASTA."""
+    refs = _refs(("zulu", 900), ("mike", 900))
+    cfg = _cfg()
+
+    def failing(port, payload, timeout):
+        if payload["unit"]["contig"] == "mike":
+            raise ConnectionError("die")
+        return _polished_reply(payload)
+
+    identity = {"version": "boot", "params_fingerprint": "f" * 16,
+                "quantize": None}
+    with pytest.raises(PoisonedUnit):
+        _core(FakeFleet(2), cfg, tmp_path, refs, failing, "out.fa",
+              identity=identity)
+
+    healthy = lambda p, payload, t: _polished_reply(payload)  # noqa: E731
+    int8 = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, quantize="int8")
+    )
+    with pytest.raises(JournalMismatch):
+        _core(FakeFleet(2), int8, tmp_path, refs, healthy, "out.fa",
+              resume=True, identity=identity)
+    with pytest.raises(JournalMismatch):
+        _core(FakeFleet(2), cfg, tmp_path, refs, healthy, "out.fa",
+              resume=True,
+              identity=dict(identity, version="v2-rolled-out"))
+    # unit geometry is identity too: a different --unit-bases would
+    # re-derive different unit uids and silently miss every committed
+    # span unit — refused instead
+    rebased = dataclasses.replace(
+        cfg, distpolish=dataclasses.replace(cfg.distpolish,
+                                            unit_bases=1234)
+    )
+    with pytest.raises(JournalMismatch):
+        _core(FakeFleet(2), rebased, tmp_path, refs, healthy, "out.fa",
+              resume=True, identity=identity)
+    # the matching identity still resumes fine
+    out, _ = _core(FakeFleet(2), cfg, tmp_path, refs, healthy, "out.fa",
+                   resume=True, identity=identity)
+    assert len(read_fasta(out)) == 2
+
+
+def test_distributed_meta_carries_quantize_and_model_identity():
+    cfg = _cfg()
+    meta = distributed_meta("r.fa", "x.bam", 7, cfg,
+                            {"version": "boot", "fp": "aa"})
+    assert meta["mode"] == "distributed"
+    assert meta["quantize"] is None
+    assert meta["config"]["model"]["quantize"] is None
+    assert meta["model"] == {"version": "boot", "fp": "aa"}
+    int8 = dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, quantize="int8")
+    )
+    assert distributed_meta("r.fa", "x.bam", 7, int8,
+                            {})["quantize"] == "int8"
+
+
+# -- FleetDraining client satellite ------------------------------------------
+
+
+class _FixedReplyHandler(BaseHTTPRequestHandler):
+    reply = (503, {"error": "fleet draining", "retry_after_s": 0.05})
+
+    def log_message(self, *a):
+        pass
+
+    def do_POST(self):  # noqa: N802
+        self.rfile.read(int(self.headers.get("Content-Length", "0")))
+        code, body = self.reply
+        raw = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
+
+def _fixed_server(reply):
+    handler = type("H", (_FixedReplyHandler,), {"reply": reply})
+    server = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def test_client_raises_typed_fleet_draining_without_retrying():
+    """ISSUE 15 satellite: a draining 503 surfaces as the typed
+    FleetDraining (ServerBusy subclass) IMMEDIATELY — the retry budget
+    is for transient pressure, not a deliberate drain window."""
+    from roko_tpu.serve.client import FleetDraining, PolishClient, ServerBusy
+
+    server = _fixed_server(
+        (503, {"error": "fleet draining", "retry_after_s": 2.5})
+    )
+    try:
+        client = PolishClient(
+            f"http://127.0.0.1:{server.server_address[1]}"
+        )
+        sleeps = []
+        client._sleep = sleeps.append
+        with pytest.raises(FleetDraining) as exc:
+            client.polish("ACGT", np.zeros((0, 90, 2), np.int64),
+                          np.zeros((0, 200, 90), np.uint8), retries=5)
+        assert isinstance(exc.value, ServerBusy)  # existing handlers hold
+        assert exc.value.retry_after_s == 2.5
+        assert sleeps == []  # zero budget burned against the drain
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_client_fleet_draining_survives_malformed_retry_after():
+    """A draining body with a junk retry_after_s must still classify as
+    FleetDraining — the detail parse cannot be hostage to the float()."""
+    from roko_tpu.serve.client import FleetDraining, PolishClient
+
+    server = _fixed_server(
+        (503, {"error": "fleet draining", "retry_after_s": None})
+    )
+    try:
+        client = PolishClient(
+            f"http://127.0.0.1:{server.server_address[1]}"
+        )
+        sleeps = []
+        client._sleep = sleeps.append
+        with pytest.raises(FleetDraining) as exc:
+            client.polish("ACGT", np.zeros((0, 90, 2), np.int64),
+                          np.zeros((0, 200, 90), np.uint8), retries=5)
+        assert exc.value.retry_after_s == 1.0  # the fallback wait
+        assert sleeps == []
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_job_core_converts_sam_input_before_shipping(tmp_path):
+    """SAM text input converts ONCE coordinator-side (the
+    features-pipeline rule) — workers receive the converted BAM path,
+    while the journal identity records the ORIGINAL path so resumes
+    stay stable across temp dirs."""
+    from roko_tpu.features import pipeline as featpl
+
+    refs = _refs(("zulu", 900),)
+    fasta = str(tmp_path / "draft.fa")
+    write_fasta(fasta, refs)
+    out = str(tmp_path / "out.fa")
+
+    shipped = []
+
+    def transport(port, payload, timeout):
+        shipped.append(payload["bam"])
+        return _polished_reply(payload)
+
+    converted = str(tmp_path / "converted.bam")
+    real_ensure = featpl._ensure_bam
+    featpl._ensure_bam = lambda path, stack: converted
+    try:
+        _run_job_core(
+            FakeFleet(2), _cfg(),
+            ref=fasta, bam="reads.sam", out=out, seed=0, resume=False,
+            model_identity={"version": "boot"},
+            transport=transport, log=_quiet,
+        )
+    finally:
+        featpl._ensure_bam = real_ensure
+    assert shipped == [converted]
+    # identity pinned the ORIGINAL path: a resume with the same input
+    # matches even though the temp conversion path differs per run
+    meta = distributed_meta(fasta, "reads.sam", 0, _cfg(),
+                            {"version": "boot"})
+    assert meta["bam"] == "reads.sam"
+
+
+def test_client_busy_503_still_retries_to_service_unavailable():
+    from roko_tpu.serve.client import PolishClient, ServiceUnavailable
+
+    server = _fixed_server(
+        (503, {"error": "fleet at capacity", "retry_after_s": 0.01})
+    )
+    try:
+        client = PolishClient(
+            f"http://127.0.0.1:{server.server_address[1]}"
+        )
+        sleeps = []
+        client._sleep = sleeps.append
+        with pytest.raises(ServiceUnavailable) as exc:
+            client.polish("ACGT", np.zeros((0, 90, 2), np.int64),
+                          np.zeros((0, 200, 90), np.uint8), retries=2)
+        assert exc.value.attempts == 3
+        assert len(sleeps) == 2  # the budget applied, as before
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+# -- supervisor surface: POST /job + GET /jobz --------------------------------
+
+
+@pytest.fixture
+def front(tmp_path):
+    """A supervisor front end over a NEVER-STARTED real Fleet — enough
+    to exercise the /job and /jobz route wiring without processes."""
+    from roko_tpu.config import FleetConfig
+    from roko_tpu.serve.fleet import Fleet
+    from roko_tpu.serve.supervisor import make_front_server
+
+    cfg = RokoConfig(
+        model=TINY,
+        fleet=FleetConfig(workers=1, runtime_dir=str(tmp_path / "rt")),
+    )
+    fleet = Fleet(cfg, worker_command=lambda *_: [], log=_quiet)
+    server = make_front_server(fleet, port=0)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield cfg, fleet, server
+    server.shutdown()
+    server.server_close()
+    thread.join(5.0)
+
+
+def _http(port, path, payload=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=None if payload is None else json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"} if payload else {},
+        method="POST" if payload is not None else "GET",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def test_jobz_idle_then_snapshot(front):
+    cfg, fleet, server = front
+    port = server.server_address[1]
+    assert _http(port, "/jobz") == (200, {"state": "idle"})
+    refs = _refs(("zulu", 900),)
+    fleet.job = _job(
+        FakeFleet(1), _cfg(), refs,
+        lambda p, payload, t: _polished_reply(payload),
+    )
+    fleet.job.run()
+    code, body = _http(port, "/jobz")
+    assert code == 200 and body["state"] == "done"
+    assert body["counts"] == {"committed": 1}
+    assert "zulu@0+1" in body["units"]
+
+
+def test_post_job_unconfigured_501_validation_and_409(front, tmp_path):
+    cfg, fleet, server = front
+    port = server.server_address[1]
+    # bare front ends answer 501 (the _start_job wiring is run_supervisor's)
+    code, body = _http(port, "/job", {"ref": "x", "bam": "y", "out": "z"})
+    assert code == 501
+    server._start_job = make_job_starter(fleet, cfg, log=_quiet)
+    # bad paths refuse 400 with the one non-oracle message
+    code, body = _http(
+        port, "/job", {"ref": "/nope.fa", "bam": "/nope.bam", "out": "z"}
+    )
+    assert code == 400 and "readable data file" in body["error"]
+    ref = tmp_path / "d.fa"
+    write_fasta(str(ref), _refs(("zulu", 400)))
+    bam = tmp_path / "r.bam"
+    bam.write_bytes(b"\x1f\x8bstub")
+    # missing out refuses
+    code, body = _http(
+        port, "/job", {"ref": str(ref), "bam": str(bam)}
+    )
+    assert code == 400 and "out" in body["error"]
+    # one job at a time: an active job 409s with its snapshot
+    class _Busy:
+        def active(self):
+            return True
+
+        def snapshot(self):
+            return {"state": "running"}
+
+        def status(self):
+            return {"state": "rolling"}
+
+    fleet.job = _Busy()
+    code, body = _http(
+        port, "/job",
+        {"ref": str(ref), "bam": str(bam), "out": str(tmp_path / "o.fa")},
+    )
+    assert code == 409 and "already running" in body["error"]
+    # mutual exclusion with rollouts, BOTH directions: a mid-job
+    # version swap would splice two models' contigs into one rc-0
+    # FASTA (docs/PIPELINE.md "Distributed polish")
+    from roko_tpu.serve.supervisor import make_rollout_starter
+
+    roll = make_rollout_starter(fleet, None, "ckpt", cfg, log=_quiet)
+    code, body = roll({"name": "v2"})
+    assert code == 409 and "distributed polish job" in body["error"]
+    fleet.job = None
+    fleet.rollout = _Busy()
+    code, body = _http(
+        port, "/job",
+        {"ref": str(ref), "bam": str(bam), "out": str(tmp_path / "o.fa")},
+    )
+    assert code == 409 and "rollout is in progress" in body["error"]
+
+
+# -- in-process end-to-end: byte-identity vs single-process polish -----------
+
+
+@pytest.mark.slow
+def test_distpolish_in_process_byte_identical(tmp_path):
+    """The tentpole contract, minus processes: the coordinator +
+    the REAL worker-side unit executor (extract_unit_windows over a
+    warm session) must produce a FASTA byte-identical to single-process
+    streaming polish — including a span-split contig merged
+    coordinator-side and a whole-contig unit stitched worker-side."""
+    import random
+
+    import jax
+
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.pipeline.stream import run_streaming_polish
+    from roko_tpu.serve.scheduler import ContinuousBatcher
+    from roko_tpu.serve.server import _polish_unit
+    from roko_tpu.serve.session import PolishSession
+
+    from .helpers import simulate_reads
+
+    rng = random.Random(7)
+    drafts = [("zulu", random_seq(rng, 3000)), ("beta", random_seq(rng, 900))]
+    fasta = str(tmp_path / "draft.fasta")
+    write_fasta(fasta, drafts)
+    reads = []
+    for tid, (_, seq) in enumerate(drafts):
+        reads += simulate_reads(rng, seq, tid, coverage=8, read_len=300)
+    bam = str(tmp_path / "reads.bam")
+    write_sorted_bam(bam, [(n, len(s)) for n, s in drafts], reads)
+
+    cfg = RokoConfig(
+        model=TINY,
+        mesh=MeshConfig(dp=-1),
+        region=REGION,
+        serve=ServeConfig(ladder=(8,)),
+        distpolish=dataclasses.replace(FAST, unit_bases=1500),
+    )
+    params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+
+    ref_fa = str(tmp_path / "reference.fasta")
+    run_streaming_polish(
+        fasta, bam, params, cfg, out_path=ref_fa, batch_size=8,
+        log=_quiet,
+    )
+
+    session = PolishSession(params, cfg)
+    session.warmup(log=_quiet)
+    batcher = ContinuousBatcher(session)
+    try:
+        def transport(port, payload, timeout):
+            return 200, json.dumps(
+                _polish_unit(batcher, payload, None, None)
+            ).encode()
+
+        out = str(tmp_path / "distributed.fasta")
+        _run_job_core(
+            FakeFleet(2), cfg,
+            ref=fasta, bam=bam, out=out, seed=0, resume=False,
+            model_identity={"version": "boot", "fp": "x"},
+            transport=transport, log=_quiet,
+        )
+    finally:
+        batcher.stop()
+    assert open(out, "rb").read() == open(ref_fa, "rb").read()
+    assert not os.path.isdir(out + ".resume")
