@@ -1084,6 +1084,20 @@ def _measure(args) -> Dict[str, Any]:
         except Exception as e:  # report, never swallow
             detail["pipeline"] = {"error": f"{type(e).__name__}: {e}"[:300]}
         _flush_partial("pipeline", detail["pipeline"])
+    cascade_draft = getattr(args, "cascade_draft", None)
+    if cascade_draft is None:
+        # default follows the e2e scale decision (as the pipeline
+        # suite): contract-mode runs (--e2e-draft 0) skip it. Sized
+        # small — the suite runs inference four times (reference,
+        # threshold-0 identity, cold + warm cascade) on the same corpus.
+        cascade_draft = 40_000 if e2e_draft else 0
+    if cascade_draft:
+        _stamp(f"cascade suite (tier router + window cache, draft {cascade_draft})")
+        try:
+            detail["cascade"] = run_cascade_suite(cascade_draft)
+        except Exception as e:  # report, never swallow
+            detail["cascade"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _flush_partial("cascade", detail["cascade"])
     coldstart_ladder = getattr(args, "coldstart_ladder", None)
     if coldstart_ladder is None:
         # default follows the e2e scale decision (as the pipeline
@@ -1253,6 +1267,18 @@ def compare_to_previous(
             pairs[f"precision.{kind}.{col}"] = (
                 (row or {}).get(col), prow.get(col),
             )
+    # cascade rows (ISSUE 16): reference vs cascaded throughput plus
+    # the routing-quality columns, same noise discipline
+    for col in (
+        "reference_windows_per_sec",
+        "cascade_windows_per_sec",
+        "escalation_pct",
+        "cache_hit_rate",
+    ):
+        pairs[f"cascade.{col}"] = (
+            (cur_d.get("cascade") or {}).get(col),
+            (prev_d.get("cascade") or {}).get(col),
+        )
     # mesh rows (ROADMAP item 2): per-device-count windows/sec on the
     # same fixed global work, same noise discipline
     for n, row in ((cur_d.get("mesh") or {}).get("rows") or {}).items():
@@ -1388,6 +1414,8 @@ def _run_child_bench(args, budget_s: float, log, platform: str = "tpu"):
             cmd += ["--e2e-draft", str(args.e2e_draft)]
         if getattr(args, "pipeline_draft", None) is not None:
             cmd += ["--pipeline-draft", str(args.pipeline_draft)]
+        if getattr(args, "cascade_draft", None) is not None:
+            cmd += ["--cascade-draft", str(args.cascade_draft)]
         if getattr(args, "coldstart_ladder", None) is not None:
             cmd += [
                 "--coldstart-ladder",
@@ -1580,6 +1608,127 @@ def run_e2e_suite(draft_len: int = 2_000_000, coverage: int = 20) -> Dict[str, A
     )
     out["polished_contigs"] = len(polished)
     out["stage_breakdown"] = lines[-6:]  # StageTimer report lines
+    return out
+
+
+def run_cascade_suite(
+    draft_len: int = 40_000, coverage: int = 20, threshold: float = 0.05
+) -> Dict[str, Any]:
+    """Adaptive-compute cascade (ISSUE 16): the same sim corpus through
+    plain ``run_inference`` (reference), through the cascade at
+    threshold 0 (every window escalates — output must be sha256-identical
+    to the reference, the byte-identity gate), and through the cascade at
+    the working threshold twice against one on-disk window-cache sidecar
+    (cold, then warm — the warm run's hit rate is what a distpolish
+    fleet sharing the sidecar would see). Reports windows/sec for both
+    paths, the escalation fraction, and cold/warm cache hit rates."""
+    import dataclasses
+    import hashlib
+    import os
+    import random
+    import tempfile
+
+    import jax
+
+    from roko_tpu.config import (
+        CascadeConfig,
+        ModelConfig,
+        RokoConfig,
+        default_compute_dtype,
+    )
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.infer import run_inference
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+    from roko_tpu.models.model import RokoModel
+    from roko_tpu.sim import random_seq, simulate_reads
+
+    def _sha(polished: Dict[str, str]) -> str:
+        h = hashlib.sha256()
+        for name in sorted(polished):
+            h.update(name.encode())
+            h.update(b"\x00")
+            h.update(polished[name].encode())
+            h.update(b"\x00")
+        return h.hexdigest()
+
+    quiet = lambda *a, **k: None  # noqa: E731
+    out: Dict[str, Any] = {
+        "draft_len": draft_len, "coverage": coverage, "threshold": threshold,
+    }
+    rng = random.Random(0)
+    with tempfile.TemporaryDirectory() as td:
+        fasta = os.path.join(td, "draft.fasta")
+        bam = os.path.join(td, "reads.bam")
+        h5 = os.path.join(td, "infer.hdf5")
+        draft = random_seq(rng, draft_len)
+        read_len = min(3000, max(100, draft_len // 4))
+        records = simulate_reads(
+            rng, draft, 0, coverage=coverage, read_len=read_len
+        )
+        write_fasta(fasta, [("ctg", draft)])
+        write_sorted_bam(bam, [("ctg", draft_len)], records)
+        n = run_features(
+            fasta, bam, h5, seed=0,
+            workers=max(1, os.cpu_count() or 1), log=quiet,
+        )
+        out["windows"] = n
+
+        cfg = RokoConfig(
+            model=ModelConfig(compute_dtype=default_compute_dtype())
+        )
+        params = RokoModel(cfg.model).init(jax.random.PRNGKey(0))
+
+        t0 = time.perf_counter()
+        ref = run_inference(
+            h5, params, cfg, batch_size=512, prefetch=4, log=quiet
+        )
+        ref_s = time.perf_counter() - t0
+        ref_sha = _sha(ref)
+        out["reference_windows_per_sec"] = round(n / ref_s, 1)
+
+        # byte-identity gate: threshold 0 escalates EVERY window, so the
+        # cascade path must reproduce the plain session path bit-for-bit
+        zero_cfg = dataclasses.replace(
+            cfg, cascade=CascadeConfig(enabled=True, threshold=0.0)
+        )
+        zero = run_inference(
+            h5, params, zero_cfg, batch_size=512, prefetch=4, log=quiet
+        )
+        out["threshold0_identical"] = _sha(zero) == ref_sha
+
+        cache_dir = os.path.join(td, "wcache")
+        casc_cfg = dataclasses.replace(
+            cfg,
+            cascade=CascadeConfig(
+                enabled=True, threshold=threshold, cache_dir=cache_dir
+            ),
+        )
+        cold_stats: Dict[str, Any] = {}
+        t0 = time.perf_counter()
+        run_inference(
+            h5, params, casc_cfg, batch_size=512, prefetch=4,
+            log=quiet, cascade_stats=cold_stats,
+        )
+        casc_s = time.perf_counter() - t0
+        out["cascade_windows_per_sec"] = round(n / casc_s, 1)
+        out["speedup_vs_reference"] = round(ref_s / casc_s, 2)
+        out["escalation_pct"] = round(
+            100.0 * cold_stats.get("escalation_fraction", 0.0), 1
+        )
+        out["cold_cache_hit_rate"] = round(
+            cold_stats.get("cache_hit_rate", 0.0), 3
+        )
+        # warm: a fresh router over the SAME sidecar (what a second
+        # distpolish worker sharing the coordinator's cache sees)
+        warm_stats: Dict[str, Any] = {}
+        run_inference(
+            h5, params, casc_cfg, batch_size=512, prefetch=4,
+            log=quiet, cascade_stats=warm_stats,
+        )
+        out["cache_hit_rate"] = round(
+            warm_stats.get("cache_hit_rate", 0.0), 3
+        )
     return out
 
 
@@ -2763,6 +2912,15 @@ def main(argv=None) -> None:
         default=None,
         help="draft length for the staged-vs-streaming pipeline suite "
         "(default: 500 kb on TPU, 60 kb elsewhere; 0 disables)",
+    )
+    ap.add_argument(
+        "--cascade-draft",
+        type=int,
+        default=None,
+        help="draft length for the cascade suite (reference vs cascaded "
+        "windows/sec, escalation %%, cold/warm window-cache hit rate, "
+        "threshold-0 byte-identity; default 40 kb when the e2e suite "
+        "runs; 0 disables)",
     )
     ap.add_argument(
         "--coldstart-ladder",

@@ -230,6 +230,50 @@ def _obs_args(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _cascade_args(p: argparse.ArgumentParser) -> None:
+    """Adaptive-compute knobs (roko_tpu/cascade, docs/SERVING.md
+    "Adaptive compute")."""
+    p.add_argument(
+        "--cascade", nargs="?", const=-1.0, type=float, default=None,
+        metavar="THRESHOLD",
+        help="enable the confidence cascade: cheap tier first, escalate "
+        "only uncertain windows to the reference model. Optional value "
+        "sets the escalation threshold in [0,1] (0 escalates everything "
+        "— output byte-identical to the plain path; 1 escalates "
+        "nothing; 1-threshold is the confidence keep-floor); bare "
+        "--cascade keeps the config default (0.05)",
+    )
+    p.add_argument(
+        "--cascade-tier", choices=("majority", "model"), default=None,
+        help="tier-1 kind: 'majority' (pileup majority vote, host-side) "
+        "or 'model' (a named registry version; needs --cascade-version)",
+    )
+    p.add_argument(
+        "--cascade-version", default=None, metavar="NAME",
+        help="registry version for --cascade-tier model (digest-verified)",
+    )
+    p.add_argument(
+        "--cascade-method", choices=("max_softmax", "margin"), default=None,
+        help="calibrated confidence function (default max_softmax)",
+    )
+    p.add_argument(
+        "--cascade-calibration", default=None, metavar="PATH",
+        help="temperature-scaling artifact JSON (fitted on held-out "
+        "data, lives beside the checkpoint manifest; refuses a "
+        "params-digest mismatch)",
+    )
+    p.add_argument(
+        "--cascade-cache-bytes", type=int, default=None, metavar="N",
+        help="in-memory window-cache LRU byte cap (0 disables; "
+        "default 64 MiB)",
+    )
+    p.add_argument(
+        "--cascade-cache-dir", default=None, metavar="DIR",
+        help="shared on-disk window-cache sidecar (identity-pinned "
+        "meta.json; a distpolish fleet shares one across workers)",
+    )
+
+
 def _window_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--window-rows", type=int, default=None, help="pileup rows per window")
     p.add_argument("--window-cols", type=int, default=None, help="pileup columns per window")
@@ -361,11 +405,26 @@ def _build_config(args: argparse.Namespace):
     )
     if getattr(args, "no_guard", None):
         guard = dataclasses.replace(guard, enabled=False)
+    cascade = over(
+        base.cascade,
+        tier="cascade_tier", tier_version="cascade_version",
+        method="cascade_method", calibration_path="cascade_calibration",
+        cache_bytes="cascade_cache_bytes", cache_dir="cascade_cache_dir",
+    )
+    # --cascade enables; its optional value (sentinel -1.0 = "bare
+    # flag") sets the threshold on top of the config layer
+    casc_flag = getattr(args, "cascade", None)
+    if casc_flag is not None:
+        cascade = dataclasses.replace(
+            cascade, enabled=True,
+            **({} if casc_flag == -1.0 else {"threshold": casc_flag}),
+        )
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, data=data, mesh=mesh, serve=serve,
         fleet=fleet, pipeline=pipeline, distpolish=distpolish,
         resilience=resilience, compile=compile_cfg, guard=guard,
+        cascade=cascade,
     )
 
 
@@ -500,6 +559,8 @@ def cmd_bench(args: argparse.Namespace) -> int:
         argv += ["--e2e-draft", str(args.e2e_draft)]
     if args.pipeline_draft is not None:
         argv += ["--pipeline-draft", str(args.pipeline_draft)]
+    if args.cascade_draft is not None:
+        argv += ["--cascade-draft", str(args.cascade_draft)]
     if args.coldstart_ladder is not None:
         argv += ["--coldstart-ladder", args.coldstart_ladder]
     if args.bench_iterations is not None:
@@ -1194,6 +1255,7 @@ def build_parser() -> argparse.ArgumentParser:
     _mesh_args(p)
     _window_args(p)
     _compile_args(p)
+    _cascade_args(p)
     p.set_defaults(fn=cmd_inference)
 
     p = sub.add_parser("convert", help="torch .pth -> native checkpoint")
@@ -1279,6 +1341,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--pipeline-draft", type=int, default=None,
         help="staged-vs-streaming pipeline suite draft length "
         "(0 disables; default 500 kb on TPU, 60 kb elsewhere)",
+    )
+    p.add_argument(
+        "--cascade-draft", type=int, default=None,
+        help="cascade suite draft length (reference vs cascaded "
+        "windows/sec, escalation %%, cache hit rate, threshold-0 "
+        "byte-identity; 0 disables; default 40 kb when e2e runs)",
     )
     p.add_argument(
         "--coldstart-ladder", default=None,
@@ -1422,6 +1490,7 @@ def build_parser() -> argparse.ArgumentParser:
     _window_args(p)
     _resilience_args(p)
     _compile_args(p)
+    _cascade_args(p)
     _obs_args(p)
     p.set_defaults(fn=cmd_polish)
 
@@ -1530,6 +1599,7 @@ def build_parser() -> argparse.ArgumentParser:
     _window_args(p)
     _resilience_args(p, serve=True)
     _compile_args(p)
+    _cascade_args(p)
     _obs_args(p)
     p.set_defaults(fn=cmd_serve)
 

@@ -78,7 +78,10 @@ def warmup_ladder(
         report.per_rung_s[rung] = time.perf_counter() - t_r
 
     if parallel and len(rungs) > 1:
-        workers = max_workers or min(len(rungs), os.cpu_count() or 1)
+        # floor at 2: compiles block in XLA with the GIL released, so
+        # parallel warmup must overlap rungs even on a 1-core host —
+        # otherwise "parallel" silently degrades to serial there
+        workers = max_workers or min(len(rungs), max(os.cpu_count() or 1, 2))
         workers = max(1, min(workers, len(rungs)))
         with ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="roko-warmup"

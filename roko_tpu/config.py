@@ -661,6 +661,68 @@ class GuardConfig:
 
 
 @dataclass(frozen=True)
+class CascadeConfig:
+    """Adaptive compute (roko_tpu/cascade; docs/SERVING.md "Adaptive
+    compute"): route every window through a cheap tier first, escalate
+    only the uncertain rest to the reference model, and answer repeated
+    windows from a content-addressed cache."""
+
+    #: master switch — False keeps the plain single-tier path everywhere
+    enabled: bool = False
+    #: tier-1 kind: "majority" (the pileup majority vote, host-side,
+    #: zero device cost) or "model" (a named registry version)
+    tier: str = "majority"
+    #: registry version name for ``tier="model"`` (PR 12 registry;
+    #: resolution re-verifies bundle + params digests)
+    tier_version: Optional[str] = None
+    #: escalation knob, pinned at both ends: windows with calibrated
+    #: confidence <= 1 - threshold escalate. 0 escalates EVERYTHING
+    #: (output byte-identical to the plain path — the identity gate);
+    #: 1 escalates nothing. The useful range is SMALL values: the
+    #: keep-floor is 1 - threshold, so 0.05 keeps only windows whose
+    #: weakest column is >= 0.95 confident (max_softmax is bounded
+    #: below by 1/NUM_CLASSES and margin by 0.5, so thresholds past
+    #: those bounds can never escalate — 0.05 holds held-out Q at the
+    #: reference on the sim gate while escalating ~16%).
+    threshold: float = 0.05
+    #: confidence function: "max_softmax" or "margin" (top-2 logit gap)
+    method: str = "max_softmax"
+    #: temperature-scaling artifact (JSON beside the checkpoint
+    #: manifest); None = the tier default (MAJORITY_TEMPERATURE for
+    #: raw count-logits, 1.0 for the model tier)
+    calibration_path: Optional[str] = None
+    #: in-memory LRU byte cap for the window cache; 0 disables it
+    cache_bytes: int = 64 * 2**20
+    #: on-disk sidecar directory a distpolish fleet shares (identity-
+    #: pinned via meta.json); None = in-memory only
+    cache_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.tier not in ("majority", "model"):
+            raise ValueError(
+                f"cascade.tier must be 'majority' or 'model', got {self.tier!r}"
+            )
+        if self.method not in ("max_softmax", "margin"):
+            raise ValueError(
+                f"cascade.method must be 'max_softmax' or 'margin', "
+                f"got {self.method!r}"
+            )
+        if not 0.0 <= self.threshold <= 1.0:
+            raise ValueError(
+                f"cascade.threshold must lie in [0, 1], got {self.threshold}"
+            )
+        if self.cache_bytes < 0:
+            raise ValueError(
+                f"cascade.cache_bytes must be >= 0, got {self.cache_bytes}"
+            )
+        if self.tier == "model" and not self.tier_version:
+            raise ValueError(
+                "cascade.tier='model' needs cascade.tier_version "
+                "(a model-registry name)"
+            )
+
+
+@dataclass(frozen=True)
 class RokoConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
@@ -676,6 +738,7 @@ class RokoConfig:
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     compile: CompileConfig = field(default_factory=CompileConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)
+    cascade: CascadeConfig = field(default_factory=CascadeConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -702,6 +765,7 @@ class RokoConfig:
             resilience=ResilienceConfig(**raw.get("resilience", {})),
             compile=CompileConfig(**raw.get("compile", {})),
             guard=GuardConfig(**raw.get("guard", {})),
+            cascade=CascadeConfig(**raw.get("cascade", {})),
         )
 
 

@@ -377,6 +377,7 @@ def run_inference(
     trace_dir: Optional[str] = None,
     log: Callable[[str], None] = print,
     vote_sparse_threshold: Optional[int] = None,
+    cascade_stats: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, str]:
     """Predict votes for every window in ``data_path`` and stitch each
     contig; returns {contig: polished_seq}. ``trace_dir`` writes a
@@ -456,6 +457,22 @@ def run_inference(
             ),
         )
 
+    # adaptive compute (roko_tpu/cascade, docs/SERVING.md "Adaptive
+    # compute"): cheap-tier + cache routing in front of the device; only
+    # the escalated subset pays the reference predict. Built against the
+    # post-quantize params (the exact tree tier 2 predicts with), so the
+    # cache keys and calibration identity match what actually runs.
+    router = None
+    if cfg.cascade.enabled:
+        from roko_tpu.cascade import build_router
+
+        router = build_router(cfg, params=params)
+
+    def tier2_predict(xe: np.ndarray) -> np.ndarray:
+        n = len(xe)
+        xp = jax.device_put(pad_windows(xe, rung_for(rungs, n)), sharding)
+        return np.asarray(jax.device_get(predict(params, xp)))[:n]
+
     def place(item):
         names, positions, x, release = item
         n = len(names)
@@ -467,6 +484,38 @@ def run_inference(
 
     t0 = time.perf_counter()
     n_windows = 0
+    if router is not None:
+        # cascaded loop: routing decides per batch what reaches the
+        # device, so batches stay host-side until after tier 1 — the
+        # one-deep device pipeline below doesn't apply (escalation is
+        # data-dependent). At threshold 0 every window escalates through
+        # tier2_predict, the same pad/rung/predict as the plain loop:
+        # output stays byte-identical (the identity gate).
+        pool = SlabPool()
+        for names, positions, x, release in iter_inference_windows(
+            data_path, batch_size, contig_filter=contig_filter, pool=pool
+        ):
+            with timer("cascade"):
+                preds = router.route(np.asarray(x), tier2_predict)
+            with timer("vote"):
+                board.add(names, positions, preds)
+            release()
+            n_windows += len(names)
+        dt = time.perf_counter() - t0
+        s = router.stats()
+        if cascade_stats is not None:
+            cascade_stats.update(s)
+        log(
+            f"inference: {n_windows} windows in {dt:.1f}s "
+            f"({n_windows / max(dt, 1e-9):.0f} windows/s) — cascade "
+            f"escalated {s['escalated']}/{s['windows']} "
+            f"({100 * s['escalation_fraction']:.1f}%), cache hit rate "
+            f"{100 * s['cache_hit_rate']:.1f}%"
+        )
+        with timer("stitch"):
+            polished = board.stitch_all()
+        timer.report(log)
+        return polished
     with device_trace(trace_dir):
         # one-deep software pipeline: dispatch batch k+1's predict
         # (async under jax) BEFORE blocking on batch k's device->host

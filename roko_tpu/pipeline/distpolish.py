@@ -1015,6 +1015,19 @@ def run_distributed_polish(
         fc = dataclasses.replace(fc, workers=2)
     fc = resolve_fleet_topology(fc)
     cfg = dataclasses.replace(cfg, fleet=fc)
+    if cfg.cascade.enabled and not cfg.cascade.cache_dir:
+        # shared content-addressed window cache (roko_tpu/cascade,
+        # docs/PIPELINE.md): one sidecar beside the output, shared by
+        # every worker this coordinator forks — each worker pins the
+        # identical cache identity (same params file + config), so a
+        # whole-genome job pays for each distinct window once
+        cfg = dataclasses.replace(
+            cfg,
+            cascade=dataclasses.replace(
+                cfg.cascade, cache_dir=out + ".cascade_cache"
+            ),
+        )
+        log(f"distpolish: shared cascade cache at {out}.cascade_cache")
 
     model_identity = {
         "version": BOOT_VERSION,

@@ -487,6 +487,23 @@ def run_streaming_polish(
             max_queue_age_ms=deadline_s * 1e3,
             rung_upgrade_fill=cfg.serve.rung_upgrade_fill,
         )
+        # adaptive compute (roko_tpu/cascade): the router wraps submit —
+        # cache + cheap-tier decide host-side at submit time and only
+        # the uncertain subset rides the batching plane; the returned
+        # future is drain-loop-compatible (done()/result(timeout)). At
+        # threshold 0 every window escalates, so the output stays
+        # byte-identical to the plain path.
+        router = None
+        if cfg.cascade.enabled:
+            from roko_tpu.cascade import build_router
+
+            router = build_router(cfg, params=params, metrics=metrics)
+
+        def submit_block(x):
+            if router is None:
+                return batcher.submit(x)
+            return router.submit(x, batcher.submit)
+
         #: submitted blocks whose predictions are not yet voted
         inflight: "deque[Tuple[str, Any, int, Any]]" = deque()
 
@@ -545,7 +562,7 @@ def run_streaming_polish(
                     if tag == _BLOCK:
                         _, contig, pos, x = item
                         inflight.append(
-                            (contig, pos, len(pos), batcher.submit(x))
+                            (contig, pos, len(pos), submit_block(x))
                         )
                     elif tag == _DONE:
                         final_counts[item[1]] = item[2]

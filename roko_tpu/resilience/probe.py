@@ -76,6 +76,7 @@ def spawn_logged(cmd, budget_s: float, **popen_kw) -> Tuple[Optional[int], str]:
 # mirrored here): probing a chip leaves its canary compile in the
 # cache, so the probe doubles as a free cache warm.
 _CANARY = (
+    "print('CANARY_UP', flush=True)\n"
     "import os\n"
     "import jax\n"
     "import jax.numpy as jnp\n"
@@ -99,21 +100,93 @@ _CANARY = (
 )
 
 
+#: stage markers the canary prints, in order, and the per-stage
+#: progress budgets (seconds): a stage that shows no new marker within
+#: its budget is declared stuck and the probe abandons EARLY — seconds,
+#: not the full wall budget. Budgets are generous enough for an honest
+#: cold path (device claim and the first XLA compile are legitimately
+#: slow) yet a wedge aborts in ~15-60s instead of the historical 300s
+#: ("backend probe still hung after 300s" in the BENCH_r0x runs).
+#: ``ROKO_BENCH_PROBE_STAGE_TIMEOUT`` overrides every stage budget.
+PROBE_STAGES = (
+    ("spawn", "CANARY_UP", 15.0),
+    ("backend_init", "DEVICES_OK", 60.0),
+    ("canary_compile", "PROBE_OK", 60.0),
+)
+
+
+def _wait_stages(proc, log_path: str, timeout_s: float):
+    """Watch the canary's log for stage markers with per-stage progress
+    deadlines. Returns ``(rc_or_None, stuck_stage_or_None, waited_s)``
+    — rc None means abandoned (never killed; see module docstring)."""
+    env_stage = os.environ.get("ROKO_BENCH_PROBE_STAGE_TIMEOUT")
+    t0 = time.monotonic()
+    hard_deadline = t0 + timeout_s
+    stage_i = 0
+    stage_t0 = t0
+    while True:
+        out = tail_file(log_path)
+        while stage_i < len(PROBE_STAGES) and PROBE_STAGES[stage_i][1] in out:
+            stage_i += 1
+            stage_t0 = time.monotonic()
+        rc = proc.poll()
+        if rc is not None:
+            return rc, None, time.monotonic() - t0
+        now = time.monotonic()
+        if stage_i < len(PROBE_STAGES):
+            stage, _marker, budget = PROBE_STAGES[stage_i]
+            budget = float(env_stage) if env_stage else budget
+            if now - stage_t0 > budget:
+                return None, stage, now - t0
+        if now >= hard_deadline:
+            stage = (
+                PROBE_STAGES[stage_i][0]
+                if stage_i < len(PROBE_STAGES)
+                else "exit"
+            )
+            return None, stage, now - t0
+        time.sleep(0.5)
+
+
 def probe_backend(timeout_s: float, log) -> Tuple[bool, str, Optional[str]]:
     """Can a fresh process initialize the JAX backend AND compile?
 
     Runs in a subprocess so a wedged relay hangs the probe child, not
-    the caller. A canary hang surfaces as DEVICES_OK-without-PROBE_OK
-    inside ``timeout_s`` and callers fall back (bench: to CPU, with the
-    diagnostic in ``tpu_error``). Returns ``(ok, reason, platform)`` —
+    the caller. The child's progress is watched stage by stage (spawn ->
+    backend_init -> canary_compile); a stage that stalls past its budget
+    abandons the probe EARLY — callers fall back to CPU in seconds, not
+    minutes — and emits a structured ``watchdog`` obs event naming the
+    stuck stage. The child is still never killed (killing a TPU client
+    mid-claim wedges the relay). Returns ``(ok, reason, platform)`` —
     ``platform`` is the backend the probe actually saw (``"tpu"``,
     ``"cpu"``, ...) or None when the probe failed before reporting
     one."""
-    rc, out = spawn_logged([sys.executable, "-c", _CANARY], timeout_s)
+    from roko_tpu.obs import events as obs_events
+
+    with tempfile.NamedTemporaryFile(
+        "w+", suffix=".log", delete=False
+    ) as logf:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _CANARY],
+            stdout=logf, stderr=subprocess.STDOUT,
+        )
+        rc, stuck_stage, waited = _wait_stages(proc, logf.name, timeout_s)
+        out = tail_file(logf.name)
+    if rc is not None:
+        try:
+            os.unlink(logf.name)
+        except OSError:
+            pass
     if rc is None:
+        obs_events.emit(
+            "watchdog", "probe_stuck", log=log,
+            stage=stuck_stage, waited_s=round(waited, 1),
+            budget_s=timeout_s,
+        )
         return False, (
-            f"backend probe still hung after {timeout_s:.0f}s "
-            f"(relay wedged?); probe abandoned, not killed. tail: {out[-300:]}"
+            f"backend probe still hung after {waited:.0f}s "
+            f"(stuck in stage {stuck_stage!r}; relay wedged?); probe "
+            f"abandoned, not killed. tail: {out[-300:]}"
         ), None
     if rc != 0 or "PROBE_OK" not in out:
         return False, f"backend probe rc={rc}: {out[-400:]}", None

@@ -91,6 +91,9 @@ PASSTHROUGH_SERIES = (
     ("roko_serve_scheduler_occupancy", "gauge"),
     ("roko_compile_cache_hits", "counter"),
     ("roko_compile_cache_misses", "counter"),
+    ("roko_serve_cascade_windows_total", "counter"),
+    ("roko_serve_cascade_escalated_total", "counter"),
+    ("roko_serve_cascade_cache_hits_total", "counter"),
 )
 
 #: connection-level failures that mean "this worker did not answer" —

@@ -70,6 +70,7 @@ HISTOGRAM_SERIES = (
     "roko_request_latency_seconds",
     "roko_queue_wait_seconds",
     "roko_device_time_seconds",
+    "roko_cascade_tier_seconds",
 )
 
 
@@ -124,6 +125,17 @@ class ServeMetrics:
         )
         self.hist_queue_wait = HistogramFamily("roko_queue_wait_seconds")
         self.hist_device = HistogramFamily("roko_device_time_seconds")
+        #: cascade per-tier time, labeled tier1/tier2 (mergeable like the
+        #: rest — a fleet's escalation cost aggregates by bucket-sum)
+        self.hist_cascade = HistogramFamily(
+            "roko_cascade_tier_seconds", label="tier"
+        )
+        #: cascade counters (docs/SERVING.md "Adaptive compute"); stay 0
+        #: and render only when a router is attached
+        self._cascade_windows = 0
+        self._cascade_escalated = 0
+        self._cascade_cache_hits = 0
+        self.cascade_enabled = False
 
     def size_class(self, windows: int) -> str:
         """Ladder-rung bucket label for an n-window request: ``le{r}``
@@ -145,6 +157,27 @@ class ServeMetrics:
         # the histogram sees every request the summary sees, so a
         # bucket-derived fleet p99 is consistent with per-worker data
         self.hist_latency.observe(seconds, label)
+
+    def observe_cascade(
+        self,
+        *,
+        windows: int = 0,
+        escalated: int = 0,
+        cache_hits: int = 0,
+        tier1_seconds: Optional[float] = None,
+        tier2_seconds: Optional[float] = None,
+    ) -> None:
+        """One routed batch (CascadeRouter calls this): window counters
+        plus the per-tier time decomposition."""
+        with self._lock:
+            self.cascade_enabled = True
+            self._cascade_windows += windows
+            self._cascade_escalated += escalated
+            self._cascade_cache_hits += cache_hits
+        if tier1_seconds is not None:
+            self.hist_cascade.observe(tier1_seconds, "tier1")
+        if tier2_seconds is not None:
+            self.hist_cascade.observe(tier2_seconds, "tier2")
 
     def inc(self, name: str, by: int = 1) -> None:
         with self._lock:
@@ -259,9 +292,32 @@ class ServeMetrics:
                     f'{lat}_sum{{size_class="{label}"}} '
                     f"{self.timer.totals.get(stage, 0.0):.6f}"
                 )
+        if self.cascade_enabled:
+            with self._lock:
+                cw, ce, ch = (
+                    self._cascade_windows,
+                    self._cascade_escalated,
+                    self._cascade_cache_hits,
+                )
+            lines.append(f"# TYPE {_PREFIX}cascade_windows_total counter")
+            lines.append(f"{_PREFIX}cascade_windows_total {cw}")
+            lines.append(f"# TYPE {_PREFIX}cascade_escalated_total counter")
+            lines.append(f"{_PREFIX}cascade_escalated_total {ce}")
+            lines.append(f"# TYPE {_PREFIX}cascade_cache_hits_total counter")
+            lines.append(f"{_PREFIX}cascade_cache_hits_total {ch}")
+            lines.append(f"# TYPE {_PREFIX}cascade_escalation_fraction gauge")
+            lines.append(
+                f"{_PREFIX}cascade_escalation_fraction "
+                + (f"{ce / cw:.4f}" if cw else "NaN")
+            )
+            lines.append(f"# TYPE {_PREFIX}cascade_cache_hit_rate gauge")
+            lines.append(
+                f"{_PREFIX}cascade_cache_hit_rate "
+                + (f"{ch / cw:.4f}" if cw else "NaN")
+            )
         # mergeable histograms last (fleet-level names, no serve prefix:
         # the supervisor bucket-sums these across workers)
         for hist in (self.hist_latency, self.hist_queue_wait,
-                     self.hist_device):
+                     self.hist_device, self.hist_cascade):
             lines.extend(hist.render())
         return "\n".join(lines) + "\n"

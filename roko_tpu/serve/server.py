@@ -139,9 +139,59 @@ def _decode_array(
     return arr
 
 
+def _cascade_override(payload: Dict[str, Any], router):
+    """Per-request cascade control (docs/SERVING.md "Adaptive
+    compute"): ``"cascade": false`` forces the plain path for this
+    request; ``"cascade": {"threshold": t}`` re-routes through a
+    same-identity router at a different threshold (identity rules mean
+    a different threshold is a different cache keyspace — no
+    cross-contamination). Absent field = server default."""
+    raw = payload.get("cascade", None)
+    if raw is None:
+        return router
+    if raw is False:
+        return None
+    if not isinstance(raw, dict):
+        raise _BadRequest(
+            "field 'cascade' must be false or an object like "
+            '{"threshold": 0.02}'
+        )
+    if router is None:
+        raise _BadRequest(
+            "cascade override given but the server has no cascade "
+            "configured (start with --cascade)"
+        )
+    try:
+        threshold = float(raw["threshold"])
+    except (KeyError, TypeError, ValueError):
+        raise _BadRequest(
+            "field 'cascade.threshold' must be a number in [0, 1]"
+        ) from None
+    if not 0.0 <= threshold <= 1.0:
+        raise _BadRequest(
+            "field 'cascade.threshold' must be a number in [0, 1]"
+        )
+    if threshold == router.threshold:
+        return router
+    return router.with_threshold(threshold)
+
+
+def _batch_predict(
+    batcher: MicroBatcher, x, trace=None, router=None,
+):
+    """One predict through the batching plane, cascaded when a router
+    is attached — the single chokepoint all three /polish shapes use."""
+    if router is None:
+        return batcher.predict(x, timeout=REQUEST_TIMEOUT_S, trace=trace)
+    return router.predict(
+        x, batcher.submit, timeout=REQUEST_TIMEOUT_S, trace=trace
+    )
+
+
 def _polish_windows(
     batcher: MicroBatcher, payload: Dict[str, Any],
     trace: Optional[RequestTrace] = None,
+    router=None,
 ) -> Dict[str, Any]:
     cfg = batcher.session.cfg.model
     draft = payload.get("draft")
@@ -174,7 +224,7 @@ def _polish_windows(
                 f"positions out of range: pos must lie in [0, {len(draft)})"
                 f" (draft length) and ins in [0, {C.MAX_INS}]"
             )
-    preds = batcher.predict(examples, timeout=REQUEST_TIMEOUT_S, trace=trace)
+    preds = _batch_predict(batcher, examples, trace=trace, router=router)
     t0 = time.perf_counter()
     board = VoteBoard({contig: draft})
     board.add([contig] * n, positions, preds)
@@ -219,6 +269,7 @@ def _polish_bam(
     batcher: MicroBatcher, payload: Dict[str, Any],
     data_root: Optional[str] = None,
     trace: Optional[RequestTrace] = None,
+    router=None,
 ) -> Dict[str, Any]:
     """Extractor convenience path: feature-extract a server-local
     ref+BAM through ``features.pipeline`` and polish every contig
@@ -257,7 +308,7 @@ def _polish_bam(
         ):
             board.add(
                 names, positions,
-                batcher.predict(x, timeout=REQUEST_TIMEOUT_S, trace=trace),
+                _batch_predict(batcher, x, trace=trace, router=router),
             )
         t0 = time.perf_counter()
         contigs = board.stitch_all()
@@ -270,6 +321,7 @@ def _polish_unit(
     batcher: MicroBatcher, payload: Dict[str, Any],
     data_root: Optional[str] = None,
     trace: Optional[RequestTrace] = None,
+    router=None,
 ) -> Dict[str, Any]:
     """Worker-side execution of ONE distributed-polish work unit
     (roko_tpu/pipeline/distpolish.py, docs/PIPELINE.md "Distributed
@@ -322,7 +374,7 @@ def _polish_unit(
     # batching plane's admission bounds (the _polish_bam rule)
     top = session.ladder[-1]
     chunks = [
-        batcher.predict(x[i:i + top], timeout=REQUEST_TIMEOUT_S, trace=trace)
+        _batch_predict(batcher, x[i:i + top], trace=trace, router=router)
         for i in range(0, n, top)
     ]
     preds = (
@@ -452,6 +504,9 @@ class _Handler(JsonRequestHandler):
     ring: Optional[TraceRing] = None
     data_root: Optional[str] = None
     worker_id: Optional[int] = None
+    #: CascadeRouter when the session serves with adaptive compute
+    #: (None = plain single-tier path; docs/SERVING.md)
+    router = None
 
     def do_GET(self) -> None:  # noqa: N802 - http.server API
         if self.path.split("?", 1)[0] == "/tracez":
@@ -646,16 +701,21 @@ class _Handler(JsonRequestHandler):
             payload = json.loads(raw.decode())
             if not isinstance(payload, dict):
                 raise _BadRequest("payload must be a JSON object")
+            router = _cascade_override(payload, self.router)
             if "unit" in payload:
                 result = _polish_unit(
-                    self.batcher, payload, self.data_root, trace=trace
+                    self.batcher, payload, self.data_root, trace=trace,
+                    router=router,
                 )
             elif "bam" in payload:
                 result = _polish_bam(
-                    self.batcher, payload, self.data_root, trace=trace
+                    self.batcher, payload, self.data_root, trace=trace,
+                    router=router,
                 )
             else:
-                result = _polish_windows(self.batcher, payload, trace=trace)
+                result = _polish_windows(
+                    self.batcher, payload, trace=trace, router=router
+                )
             trace.windows = int(result.get("windows", 0))
             result["request_id"] = rid
             result["timings"] = trace.timings()
@@ -757,11 +817,21 @@ def make_server(
         breaker = breaker or batcher.breaker
     metrics.breaker = breaker
     metrics.cpu_fallback = lambda: getattr(session, "failed_over", False)
+    # adaptive compute (roko_tpu/cascade): router built against the
+    # session's post-quantize params — its cache keys/calibration
+    # identity match exactly what the device predicts with
+    router = None
+    if session.cfg.cascade.enabled:
+        from roko_tpu.cascade import build_router
+
+        router = build_router(session.cfg, params=session.params,
+                              metrics=metrics)
     ring = TraceRing(serve_cfg.trace_ring, serve_cfg.trace_slowest)
     handler = type("RokoServeHandler", (_Handler,), {
         "batcher": batcher, "metrics": metrics, "ring": ring,
         "data_root": serve_cfg.data_root,
         "worker_id": worker_id,
+        "router": router,
     })
     server = ThreadingHTTPServer(
         (serve_cfg.host if host is None else host,
@@ -773,6 +843,7 @@ def make_server(
     server.session = session  # type: ignore[attr-defined]
     server.breaker = breaker  # type: ignore[attr-defined]
     server.ring = ring  # type: ignore[attr-defined]
+    server.router = router  # type: ignore[attr-defined]
     server._profile_lock = threading.Lock()  # type: ignore[attr-defined]
     init_lifecycle(server, rcfg.drain_deadline_s, warming=warming)
     return server

@@ -1,0 +1,655 @@
+"""Adaptive-compute cascade tests (ISSUE 16): calibration math, the
+content-addressed window cache (LRU byte cap, key disjointness, the
+on-disk sidecar's identity refusals and SIGKILL atomicity), the tier
+router's pinned threshold endpoints, the threshold-0 byte-identity
+guarantee through ``run_inference``, and the /polish per-request
+override."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from roko_tpu import constants as C
+from roko_tpu.cascade import (
+    Calibration,
+    CascadeFuture,
+    CascadeMismatch,
+    CascadeRouter,
+    DiskWindowCache,
+    WindowCache,
+    build_router,
+    cache_identity,
+    confidence_scores,
+    escalate_mask,
+    fit_calibration,
+    fit_temperature,
+    window_key,
+)
+from roko_tpu.cascade.calibration import nll, window_confidence
+from roko_tpu.cascade.router import majority_logits
+from roko_tpu.config import (
+    CascadeConfig,
+    MeshConfig,
+    ModelConfig,
+    RokoConfig,
+)
+from roko_tpu.models.model import RokoModel
+
+TINY = ModelConfig(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
+
+
+def _synthetic_logits(rng, n=400, classes=5, scale=4.0):
+    """Overconfident logits: correct class boosted, then inflated by
+    ``scale`` so T=1 is miscalibrated and the fitted T lands > 1."""
+    labels = rng.integers(0, classes, n)
+    logits = rng.normal(0, 1, (n, classes))
+    logits[np.arange(n), labels] += 1.5
+    # add label noise so saturation genuinely hurts NLL
+    flip = rng.random(n) < 0.25
+    labels[flip] = rng.integers(0, classes, int(flip.sum()))
+    return logits * scale, labels
+
+
+# -- calibration ------------------------------------------------------------
+
+
+def test_fit_temperature_improves_nll(rng):
+    logits, labels = _synthetic_logits(rng)
+    t = fit_temperature(logits, labels)
+    assert t > 1.0  # inflated logits need cooling
+    assert nll(logits, labels, t) < nll(logits, labels, 1.0)
+
+
+def test_fit_calibration_carries_receipts(rng):
+    logits, labels = _synthetic_logits(rng)
+    cal = fit_calibration(logits, labels, method="margin", params_digest="d1")
+    assert cal.method == "margin"
+    assert cal.fitted_on == len(labels)
+    assert cal.nll_after < cal.nll_before
+
+
+def test_margin_and_max_softmax_rank_agreement(rng):
+    """Both methods must order windows the same way on clean two-class
+    gaps — they differ in scale, not in which window looks weakest."""
+    gaps = np.linspace(0.5, 6.0, 20)
+    logits = np.zeros((20, 1, 5))
+    logits[:, 0, 0] = gaps  # top-1 grows with the gap
+    ms = window_confidence(logits, "max_softmax")
+    mg = window_confidence(logits, "margin")
+    assert (np.argsort(ms) == np.argsort(mg)).all()
+    assert (np.diff(ms) > 0).all() and (np.diff(mg) > 0).all()
+
+
+def test_escalate_mask_pinned_endpoints():
+    conf = np.array([0.0, 0.3, 0.999, 1.0])
+    # threshold 0: EVERYTHING escalates, including confidence exactly 1.0
+    assert escalate_mask(conf, 0.0).all()
+    # threshold 1: nothing escalates (softmax confidence is > 0)
+    assert not escalate_mask(conf, 1.0)[1:].any()
+    with pytest.raises(ValueError):
+        escalate_mask(conf, 1.5)
+
+
+def test_window_confidence_is_min_over_columns():
+    logits = np.zeros((1, 3, 5))
+    logits[0, 0, 0] = 10.0  # near-certain column
+    logits[0, 1, 0] = 10.0
+    logits[0, 2, 0] = 0.1  # one weak column gates the window
+    w = window_confidence(logits)
+    col = confidence_scores(logits)[0, 2]
+    assert w[0] == pytest.approx(col)
+
+
+def test_calibration_roundtrip_and_digest_refusal(tmp_path):
+    cal = Calibration(temperature=1.7, method="margin", params_digest="abc")
+    path = cal.save(str(tmp_path / "cal.json"))
+    back = Calibration.load(path, expect_params_digest="abc")
+    assert back == cal
+    with pytest.raises(CascadeMismatch) as e:
+        Calibration.load(path, expect_params_digest="def")
+    assert e.value.diff == {"params_digest": ("abc", "def")}
+
+
+# -- window cache -----------------------------------------------------------
+
+
+def _ident(**over):
+    base = dict(
+        params_digest="p" * 64, quantize=None, tier="majority",
+        threshold=0.9, method="max_softmax", temperature=1.0,
+    )
+    base.update(over)
+    return cache_identity(**base)
+
+
+def test_lru_byte_cap_eviction():
+    row = np.zeros(90, np.int32)  # 360 payload bytes
+    cost = 64 + row.nbytes + 128  # key + payload + overhead
+    cache = WindowCache(max_bytes=3 * cost)
+    keys = [f"{i:02x}" * 32 for i in range(5)]
+    for k in keys:
+        cache.put(k, row)
+        assert cache.bytes <= cache.max_bytes
+    s = cache.stats()
+    assert s["entries"] == 3 and s["evictions"] == 2
+    # LRU order: the two oldest were evicted
+    assert cache.get(keys[0]) is None and cache.get(keys[1]) is None
+    assert cache.get(keys[4]) is not None
+    # an entry larger than the whole cap is skipped, not thrashed
+    cache.put("big" * 22, np.zeros(10**6, np.int32))
+    assert cache.stats()["entries"] == 3
+
+
+def test_cache_key_disjoint_across_identity():
+    """Same window bytes, different params digest / quantize / threshold
+    / tier -> different keys: stale-digest serving is structurally
+    impossible, not just policed by meta.json."""
+    w = bytes(range(200)) * 90
+    base = window_key(w, _ident())
+    assert window_key(w, _ident(params_digest="q" * 64)) != base
+    assert window_key(w, _ident(quantize="int8")) != base
+    assert window_key(w, _ident(threshold=0.5)) != base
+    assert window_key(w, _ident(tier="model", tier_version="v1")) != base
+    assert window_key(w, _ident(temperature=2.0)) != base
+    assert window_key(w, _ident()) == base  # deterministic
+
+
+def test_disk_sidecar_identity_refusal(tmp_path):
+    root = str(tmp_path / "side")
+    DiskWindowCache(root, _ident())
+    # same identity reopens fine
+    DiskWindowCache(root, _ident())
+    with pytest.raises(CascadeMismatch) as e:
+        DiskWindowCache(root, _ident(params_digest="q" * 64, quantize="int8"))
+    assert set(e.value.diff) == {"params_digest", "quantize"}
+    assert "wrong bases" in str(e.value)
+
+
+def test_disk_sidecar_roundtrip_and_torn_entry(tmp_path):
+    root = str(tmp_path / "side")
+    d = DiskWindowCache(root, _ident())
+    k = window_key(b"w" * 100, d.identity)
+    row = np.arange(90, dtype=np.int32)
+    d.put(k, row)
+    assert (d.get(k) == row).all()
+    # a torn/garbage entry is a miss, never an exception
+    k2 = window_key(b"x" * 100, d.identity)
+    path = os.path.join(root, k2[:2], k2 + ".npy")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(b"\x93NUMPY\x01\x00 torn")
+    assert d.get(k2) is None
+
+
+#: child for the SIGKILL test: writes ever-larger entries to the shared
+#: sidecar until killed. Prints READY once the cache is open.
+_KILL_CHILD = """
+import sys, numpy as np
+sys.path.insert(0, {repo!r})
+from roko_tpu.cascade.cache import DiskWindowCache, cache_identity, window_key
+ident = cache_identity(params_digest="p"*64, quantize=None, tier="majority",
+                       threshold=0.9, method="max_softmax", temperature=1.0)
+d = DiskWindowCache({root!r}, ident)
+print("READY", flush=True)
+i = 0
+while True:
+    k = window_key(i.to_bytes(4, "big"), ident)
+    d.put(k, np.full(200_000, i, np.int32))
+    i += 1
+"""
+
+
+def test_sigkill_mid_write_leaves_no_torn_or_stale_entries(tmp_path):
+    """The distpolish shared-sidecar property: a worker SIGKILLed while
+    writing never publishes a torn entry (atomic tmp+rename), and a
+    process with a DIFFERENT identity can neither open the sidecar
+    (meta.json refusal) nor be served its entries (disjoint keys)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    root = str(tmp_path / "shared")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _KILL_CHILD.format(repo=repo, root=root)],
+        stdout=subprocess.PIPE, text=True,
+    )
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        # let it publish a few entries, then kill it mid-stream
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            done = sum(
+                1
+                for s in os.listdir(root)
+                if len(s) == 2 and os.path.isdir(os.path.join(root, s))
+                for name in os.listdir(os.path.join(root, s))
+                if name.endswith(".npy")
+            )
+            if done >= 3:
+                break
+            time.sleep(0.02)
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.wait()
+
+    # same identity reopens cleanly; every published entry is complete
+    d = DiskWindowCache(root, _ident(temperature=1.0))
+    n_valid = 0
+    for sub in os.listdir(root):
+        p = os.path.join(root, sub)
+        if len(sub) != 2 or not os.path.isdir(p):
+            continue
+        for name in os.listdir(p):
+            if not name.endswith(".npy"):
+                # a leftover pid-suffixed tmp from the kill is fine —
+                # it is never served (get() opens <key>.npy only)
+                assert ".npy.tmp." in name, f"unexpected file: {name}"
+                continue
+            arr = np.load(os.path.join(p, name), allow_pickle=False)
+            assert arr.shape == (200_000,)
+            assert (arr == arr[0]).all(), "torn entry contents"
+            n_valid += 1
+    assert n_valid >= 3
+    # round-trip one through the API too
+    k = window_key((0).to_bytes(4, "big"), d.identity)
+    got = d.get(k)
+    if got is not None:
+        assert (got == 0).all()
+    # a drifted identity refuses at open — no stale-digest serving
+    with pytest.raises(CascadeMismatch):
+        DiskWindowCache(root, _ident(params_digest="q" * 64))
+
+
+# -- router -----------------------------------------------------------------
+
+
+def _windows(rng, n=6):
+    return rng.integers(0, C.FEATURE_VOCAB, (n, 16, 9)).astype(np.uint8)
+
+
+class _CountingTier2:
+    """Synchronous predict_fn recording how many windows escalated."""
+
+    def __init__(self):
+        self.windows = 0
+
+    def __call__(self, x):
+        self.windows += len(x)
+        return np.zeros((len(x), x.shape[2]), np.int32)
+
+
+def test_router_threshold_endpoints(rng):
+    x = _windows(rng)
+    for threshold, want_escalated in ((0.0, len(x)), (1.0, 0)):
+        tier2 = _CountingTier2()
+        r = CascadeRouter(
+            threshold=threshold, params_digest="p" * 64, cache_bytes=0
+        )
+        r.route(x, tier2)
+        assert tier2.windows == want_escalated
+        assert r.stats()["escalated"] == want_escalated
+
+
+def test_router_threshold0_scatters_tier2_verbatim(rng):
+    """At threshold 0 the output IS tier 2's output, elementwise — the
+    in-process face of the byte-identity gate."""
+    x = _windows(rng)
+    want = rng.integers(0, C.NUM_CLASSES, (len(x), x.shape[2])).astype(np.int32)
+    r = CascadeRouter(threshold=0.0, params_digest="p" * 64, cache_bytes=0)
+    got = r.route(x, lambda xs: want[: len(xs)])
+    assert (got == want).all()
+
+
+def test_router_cache_hits_on_repeat_batch(rng):
+    x = _windows(rng)
+    tier2 = _CountingTier2()
+    r = CascadeRouter(
+        threshold=1.0, params_digest="p" * 64, cache_bytes=2**20
+    )
+    r.route(x, tier2)
+    r.route(x, tier2)
+    s = r.stats()
+    assert s["cache_hits"] == len(x)
+    assert s["cache_hit_rate"] == pytest.approx(0.5)
+    assert tier2.windows == 0
+
+
+def test_router_escalated_results_are_cached_too(rng):
+    """Escalated windows land in the cache AFTER tier 2 answers, so a
+    second pass over the same corpus (the warm distpolish worker) hits
+    for every window, not just the kept ones."""
+    x = _windows(rng)
+    tier2 = _CountingTier2()
+    r = CascadeRouter(
+        threshold=0.0, params_digest="p" * 64, cache_bytes=2**20
+    )
+    r.route(x, tier2)
+    assert tier2.windows == len(x)
+    r.route(x, tier2)
+    assert tier2.windows == len(x)  # second pass fully cache-served
+    assert r.stats()["cache_hits"] == len(x)
+
+
+def test_router_check_identity_refuses_drift():
+    r = CascadeRouter(threshold=0.5, params_digest="p" * 64, quantize="int8")
+    r.check_identity(params_digest="p" * 64, quantize="int8")
+    with pytest.raises(CascadeMismatch) as e:
+        r.check_identity(params_digest="q" * 64)
+    assert "params_digest" in e.value.diff
+
+
+def test_with_threshold_clone_shares_calibration_not_cache(rng):
+    r = CascadeRouter(
+        threshold=0.9, params_digest="p" * 64, cache_bytes=2**20
+    )
+    clone = r.with_threshold(0.5)
+    assert clone.threshold == 0.5
+    assert clone.calibration is r.calibration
+    assert clone.cache is not r.cache
+    assert clone.identity != r.identity
+    assert r.with_threshold(0.5) is clone  # memoized
+    # disjoint keyspace by construction
+    w = _windows(rng, 1)[0].tobytes()
+    assert window_key(w, r.identity) != window_key(w, clone.identity)
+
+
+def test_cascade_future_matches_predict_future_interface():
+    class _Inner:
+        def __init__(self):
+            self._preds = np.ones((2, 4), np.int32)
+
+        def done(self):
+            return True
+
+        def result(self, timeout=None):
+            return self._preds
+
+    preds = np.zeros((3, 4), np.int32)
+    fut = CascadeFuture(preds, np.array([0, 2]), _Inner())
+    assert fut.done()
+    out = fut.result(1.0)
+    assert (out[[0, 2]] == 1).all() and (out[1] == 0).all()
+    # no escalation -> immediately done without an inner future
+    fut2 = CascadeFuture(preds, np.empty(0, np.int64), None)
+    assert fut2.done() and fut2.result(0.0) is preds
+
+
+def test_majority_logits_counts_folded_votes():
+    x = np.zeros((1, 4, 2), np.uint8)
+    x[0, :, 0] = [0, 0, 6, 1]  # A, A, A(reverse strand), C
+    x[0, :, 1] = [3, 3, 3, 3]  # T unanimous
+    logits = majority_logits(x)
+    assert logits.shape == (1, 2, C.NUM_CLASSES)
+    assert logits[0, 0, 0] == 3.0 and logits[0, 0, 1] == 1.0
+    assert logits[0, 1, 3] == 4.0
+
+
+# -- build_router + run_inference byte identity -----------------------------
+
+
+def _write_corpus(rng, path, n=7):
+    from roko_tpu.data.hdf5 import DataWriter
+
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    B, W = 200, 90
+    X = rng.integers(0, C.FEATURE_VOCAB, (n, B, W)).astype(np.uint8)
+    positions = []
+    for i in range(n):
+        start = i * C.WINDOW_STRIDE
+        positions.append(
+            np.stack(
+                [np.arange(start, start + W), np.zeros(W, np.int64)], axis=1
+            )
+        )
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", positions, list(X), None)
+
+
+def test_run_inference_threshold0_byte_identity(rng, tmp_path):
+    """THE gate: cascade at threshold 0 must reproduce the plain session
+    path sha256-identically — every window escalates through the same
+    padded-rung predict, so any drift is a routing bug."""
+    import hashlib
+
+    from roko_tpu.infer import run_inference
+
+    path = tmp_path / "infer.hdf5"
+    _write_corpus(rng, path)
+    cfg = RokoConfig(model=TINY, mesh=MeshConfig(dp=8))
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    plain = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None
+    )
+    import dataclasses
+
+    casc_cfg = dataclasses.replace(
+        cfg, cascade=CascadeConfig(enabled=True, threshold=0.0)
+    )
+    stats = {}
+    cascaded = run_inference(
+        str(path), params, casc_cfg, batch_size=8, log=lambda s: None,
+        cascade_stats=stats,
+    )
+    assert cascaded == plain
+
+    def sha(d):
+        h = hashlib.sha256()
+        for name in sorted(d):
+            h.update(name.encode() + b"\0" + d[name].encode() + b"\0")
+        return h.hexdigest()
+
+    assert sha(cascaded) == sha(plain)
+    assert stats["escalation_fraction"] == 1.0
+
+
+def test_run_inference_cascade_threshold1_never_escalates(rng, tmp_path):
+    from roko_tpu.infer import run_inference
+    import dataclasses
+
+    path = tmp_path / "infer.hdf5"
+    _write_corpus(rng, path)
+    cfg = RokoConfig(
+        model=TINY, mesh=MeshConfig(dp=8),
+        cascade=CascadeConfig(enabled=True, threshold=1.0),
+    )
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    stats = {}
+    out = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None,
+        cascade_stats=stats,
+    )
+    assert set(out) == {"ctg"}
+    assert stats["escalated"] == 0 and stats["windows"] > 0
+
+
+def test_build_router_loads_calibration_and_refuses_drift(tmp_path):
+    params = RokoModel(TINY).init(jax.random.PRNGKey(0))
+    from roko_tpu.cascade.cache import params_digest
+
+    digest = params_digest(params)
+    good = str(tmp_path / "cal.json")
+    Calibration(temperature=2.0, params_digest=digest).save(good)
+    cfg = RokoConfig(
+        model=TINY,
+        cascade=CascadeConfig(enabled=True, calibration_path=good),
+    )
+    r = build_router(cfg, params=params)
+    assert r.calibration.temperature == 2.0
+    bad = str(tmp_path / "bad.json")
+    Calibration(temperature=2.0, params_digest="not-this-model").save(bad)
+    import dataclasses
+
+    cfg2 = dataclasses.replace(
+        cfg, cascade=dataclasses.replace(cfg.cascade, calibration_path=bad)
+    )
+    with pytest.raises(CascadeMismatch):
+        build_router(cfg2, params=params)
+
+
+# -- serve override + config plumbing ---------------------------------------
+
+
+def test_polish_cascade_override_parsing():
+    from roko_tpu.serve.server import _BadRequest, _cascade_override
+
+    r = CascadeRouter(threshold=0.9, params_digest="p" * 64, cache_bytes=0)
+    assert _cascade_override({}, r) is r  # absent -> server default
+    assert _cascade_override({"cascade": False}, r) is None
+    got = _cascade_override({"cascade": {"threshold": 0.5}}, r)
+    assert got.threshold == 0.5 and got is not r
+    assert _cascade_override({"cascade": {"threshold": 0.9}}, r) is r
+    for bad in ("yes", {"threshold": "x"}, {"threshold": 1.5}, {}):
+        with pytest.raises(_BadRequest):
+            _cascade_override({"cascade": bad}, r)
+    with pytest.raises(_BadRequest):  # override without a configured router
+        _cascade_override({"cascade": {"threshold": 0.5}}, None)
+
+
+def test_cascade_config_validation_and_roundtrip():
+    cfg = RokoConfig(
+        cascade=CascadeConfig(enabled=True, threshold=0.7, method="margin")
+    )
+    back = RokoConfig.from_json(cfg.to_json())
+    assert back.cascade == cfg.cascade
+    with pytest.raises(ValueError):
+        CascadeConfig(threshold=1.5)
+    with pytest.raises(ValueError):
+        CascadeConfig(tier="nope")
+    with pytest.raises(ValueError):
+        CascadeConfig(tier="model")  # model tier needs tier_version
+
+
+def test_cli_cascade_flag_layering(tmp_path):
+    from roko_tpu.cli import _build_config, build_parser
+
+    p = build_parser()
+    # bare --cascade: enable with the config-default threshold
+    args = p.parse_args(
+        ["polish", "d.fa", "r.bam", "m.ckpt", "o.fa", "--cascade"]
+    )
+    cfg = _build_config(args)
+    assert cfg.cascade.enabled and cfg.cascade.threshold == CascadeConfig().threshold
+    # --cascade T: enable AND pin the threshold; satellite knobs ride
+    args = p.parse_args(
+        [
+            "polish", "d.fa", "r.bam", "m.ckpt", "o.fa", "--cascade", "0.5",
+            "--cascade-method", "margin",
+            "--cascade-cache-dir", str(tmp_path / "wc"),
+        ]
+    )
+    cfg = _build_config(args)
+    assert cfg.cascade.enabled and cfg.cascade.threshold == 0.5
+    assert cfg.cascade.method == "margin"
+    assert cfg.cascade.cache_dir == str(tmp_path / "wc")
+    # no flag: disabled
+    args = p.parse_args(["polish", "d.fa", "r.bam", "m.ckpt", "o.fa"])
+    assert not _build_config(args).cascade.enabled
+
+
+# -- slow lane: the cascade accuracy + live-CLI identity gate ---------------
+
+
+@pytest.mark.slow
+def test_cascade_q_within_half_and_cli_threshold0_identity(tmp_path):
+    """CI cascade-gate lane: ONE f32 training run, then the held-out
+    genome polished plain (reference) and cascaded (majority tier,
+    default threshold) — the cascaded held-out Q must land within 0.5
+    of the reference while both genuinely polish — plus the LIVE
+    byte-identity gate: ``roko-tpu inference --cascade 0`` output
+    byte-identical to plain ``roko-tpu inference`` on the same
+    checkpoint (same discipline as the precision/lingru Q gates)."""
+    import dataclasses
+    import hashlib
+
+    from roko_tpu.cli import main as cli_main
+    from roko_tpu.config import TrainConfig
+    from roko_tpu.eval.assess import assess_pair
+    from roko_tpu.features.pipeline import run_features
+    from roko_tpu.infer import run_inference
+    from roko_tpu.io.bam import write_sorted_bam
+    from roko_tpu.io.fasta import write_fasta
+    from roko_tpu.sim import make_record
+    from roko_tpu.training.loop import train
+    from tests.test_end_to_end import _build_genome
+
+    truth_a, draft_a, cig_a, reads_a = _build_genome(1, 9000, "train", hp=True)
+    write_fasta(str(tmp_path / "a.fasta"), [("train", draft_a)])
+    write_sorted_bam(str(tmp_path / "a.bam"), [("train", len(draft_a))], reads_a)
+    truth_rec = make_record("truth", 0, 0, truth_a, cig_a)
+    write_sorted_bam(
+        str(tmp_path / "a_truth.bam"), [("train", len(draft_a))], [truth_rec]
+    )
+    run_features(
+        str(tmp_path / "a.fasta"), str(tmp_path / "a.bam"),
+        str(tmp_path / "train.hdf5"), bam_y=str(tmp_path / "a_truth.bam"),
+        seed=3,
+    )
+    truth_b, draft_b, _, reads_b = _build_genome(2, 6000, "eval", hp=True)
+    write_fasta(str(tmp_path / "b.fasta"), [("eval", draft_b)])
+    write_sorted_bam(str(tmp_path / "b.bam"), [("eval", len(draft_b))], reads_b)
+    run_features(
+        str(tmp_path / "b.fasta"), str(tmp_path / "b.bam"),
+        str(tmp_path / "infer.hdf5"), seed=4,
+    )
+
+    model = ModelConfig(
+        kind="gru", embed_dim=32, read_mlp=(64, 8),
+        hidden_size=64, num_layers=2, compute_dtype="float32",
+    )
+    cfg = RokoConfig(
+        model=model,
+        train=TrainConfig(batch_size=64, epochs=10, lr=1.5e-3, patience=10),
+        mesh=MeshConfig(dp=8),
+    )
+    state = train(
+        cfg, str(tmp_path / "train.hdf5"), str(tmp_path / "ckpt"),
+        log=lambda s: None,
+    )
+    params = jax.device_get(state.params)
+    draft_res = assess_pair(truth_b.encode(), draft_b.encode(), truth_name="eval")
+
+    ref = run_inference(
+        str(tmp_path / "infer.hdf5"), params, cfg,
+        batch_size=64, log=lambda s: None,
+    )["eval"]
+    ref_res = assess_pair(truth_b.encode(), ref.encode(), truth_name="eval")
+    assert ref_res.error_rate < draft_res.error_rate, (ref_res, draft_res)
+
+    stats = {}
+    casc = run_inference(
+        str(tmp_path / "infer.hdf5"), params,
+        dataclasses.replace(cfg, cascade=CascadeConfig(enabled=True)),
+        batch_size=64, log=lambda s: None, cascade_stats=stats,
+    )["eval"]
+    casc_res = assess_pair(truth_b.encode(), casc.encode(), truth_name="eval")
+    assert casc_res.error_rate < draft_res.error_rate, (casc_res, draft_res)
+    # bounded-scale Q comparison (a perfect polish has infinite Q)
+    q_ref = min(ref_res.qscore, 60.0)
+    q_casc = min(casc_res.qscore, 60.0)
+    assert q_casc >= q_ref - 0.5, (q_ref, q_casc, stats)
+    assert stats["windows"] > 0
+
+    # LIVE byte-identity: the real CLI, plain vs --cascade 0. The CLI
+    # rebuilds config from flags, so the trained model geometry rides
+    # in via --config.
+    cfg_json = str(tmp_path / "cfg.json")
+    with open(cfg_json, "w") as f:
+        f.write(cfg.to_json())
+    plain_fa = str(tmp_path / "plain.fasta")
+    casc_fa = str(tmp_path / "casc.fasta")
+    base = [
+        "inference", str(tmp_path / "infer.hdf5"), str(tmp_path / "ckpt"),
+        "--config", cfg_json,
+    ]
+    assert cli_main(base + [plain_fa, "--b", "64"]) == 0
+    assert cli_main(base + [casc_fa, "--b", "64", "--cascade", "0"]) == 0
+    with open(plain_fa, "rb") as f:
+        sha_plain = hashlib.sha256(f.read()).hexdigest()
+    with open(casc_fa, "rb") as f:
+        sha_casc = hashlib.sha256(f.read()).hexdigest()
+    assert sha_casc == sha_plain

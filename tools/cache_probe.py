@@ -6,6 +6,7 @@ its digest + rungs + the backend/jax it was built for.
     python tools/cache_probe.py --cache DIR         # a specific cache
     python tools/cache_probe.py --bundle DIR [...]  # bundle digests too
     python tools/cache_probe.py --registry [DIR]    # model registry too
+    python tools/cache_probe.py --window-cache DIR  # cascade sidecar
 
 Reads only — safe to run next to a live service. Exit 0 always (an
 absent cache is a fact, not a failure). ``ROKO_COMPILE_CACHE`` is
@@ -34,6 +35,12 @@ def main() -> int:
         help="also list the model registry (named version -> bundle "
         "digest + params manifest digest; default dir when no DIR "
         "given — docs/SERVING.md 'Model lifecycle')",
+    )
+    ap.add_argument(
+        "--window-cache", action="append", default=[], metavar="DIR",
+        help="cascade window-cache sidecar dir(s) to summarise "
+        "(identity pin from meta.json + entry count + bytes; "
+        "docs/SERVING.md 'Adaptive compute'; repeatable)",
     )
     args = ap.parse_args()
 
@@ -84,6 +91,47 @@ def main() -> int:
             f"digest={man.get('digest', '?')[:12]} "
             f"rungs={man.get('rungs')} backend={ident.get('backend')}/"
             f"{ident.get('device_kind')} jax={ident.get('jax_version')}"
+        )
+
+    for wdir in args.window_cache:
+        # read-only: parse meta.json + walk the fanout directly rather
+        # than opening a DiskWindowCache (which needs a matching run
+        # identity — the probe has none and must never refuse)
+        import json
+
+        meta_path = os.path.join(wdir, "meta.json")
+        try:
+            with open(meta_path) as f:
+                ident = json.load(f)
+        except (OSError, ValueError):
+            print(f"window-cache: {wdir} NO meta.json (not a cascade sidecar?)")
+            continue
+        entries, total = 0, 0
+        for sub in sorted(os.listdir(wdir)):
+            d = os.path.join(wdir, sub)
+            if len(sub) != 2 or not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                if name.endswith(".npy"):
+                    entries += 1
+                    try:
+                        total += os.path.getsize(os.path.join(d, name))
+                    except OSError:
+                        pass
+        print(
+            f"window-cache: {wdir} entries={entries} "
+            f"size={total / 2**20:.1f}MiB "
+            f"params={str(ident.get('params_digest', '?'))[:12]} "
+            f"quantize={ident.get('quantize', '?')} "
+            f"tier={ident.get('tier', '?')}"
+            + (
+                f"@{ident['tier_version']}"
+                if ident.get("tier_version") not in (None, "none")
+                else ""
+            )
+            + f" threshold={ident.get('threshold', '?')} "
+            f"method={ident.get('method', '?')} "
+            f"temperature={ident.get('temperature', '?')}"
         )
 
     if args.registry is not None:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import urllib.request
 
@@ -44,6 +45,16 @@ HISTOGRAM_SERIES = (
     "roko_request_latency_seconds",
     "roko_queue_wait_seconds",
     "roko_device_time_seconds",
+    "roko_cascade_tier_seconds",
+)
+
+#: cascade counters (rendered by workers when a router is attached and
+#: passed through worker-labeled by a fleet supervisor) — the probe sums
+#: them to derive the fleet escalation fraction and cache hit rate
+CASCADE_COUNTERS = (
+    "roko_serve_cascade_windows_total",
+    "roko_serve_cascade_escalated_total",
+    "roko_serve_cascade_cache_hits_total",
 )
 
 
@@ -59,7 +70,7 @@ def _ms(seconds) -> str:
 
 
 def _span_text(spans: dict) -> str:
-    order = ("queue_wait", "pack", "device", "scatter", "stitch")
+    order = ("tier1", "queue_wait", "pack", "device", "scatter", "stitch")
     parts = [f"{k}={_ms(spans[k])}" for k in order if k in spans]
     parts += [
         f"{k}={_ms(v)}" for k, v in sorted(spans.items()) if k not in order
@@ -120,28 +131,66 @@ def print_tracez(body: dict, label: str = "") -> None:
     print()
 
 
+def _counter_total(text: str, name: str):
+    """Sum a counter across its rows: the unlabeled worker row or the
+    supervisor's per-worker passthrough rows (``name{worker="i"} v``).
+    None when the series is absent (cascade disabled)."""
+    total, seen = 0.0, False
+    pat = re.compile(
+        rf"^{re.escape(name)}(?:\{{[^}}]*\}})?\s+([0-9.eE+-]+|NaN)\s*$"
+    )
+    for line in text.splitlines():
+        m = pat.match(line)
+        if m and m.group(1) != "NaN":
+            total += float(m.group(1))
+            seen = True
+    return total if seen else None
+
+
+def _hist_rows(rows, want_labels):
+    """Bucket list for rows carrying exactly ``want_labels`` beyond
+    ``__series__``/``le``."""
+    return sorted(
+        (float("inf") if dict(k)["le"] == "+Inf" else float(dict(k)["le"]),
+         int(v))
+        for k, v in rows.items()
+        if dict(k).get("__series__") == "bucket"
+        and set(dict(k)) == {"__series__", "le"} | set(want_labels)
+        and all(dict(k).get(lk) == lv for lk, lv in want_labels.items())
+    )
+
+
 def print_metrics(text: str) -> None:
     print("--- mergeable histograms (fleet-level when scraped from a "
           "supervisor) ---")
     for name in HISTOGRAM_SERIES:
         rows = parse_histogram_rows(text, name)
-        # the unlabeled aggregate row set (no size_class, no worker)
-        buckets = sorted(
-            (
-                (float("inf") if dict(k)["le"] == "+Inf"
-                 else float(dict(k)["le"]), int(v))
-                for k, v in rows.items()
-                if dict(k).get("__series__") == "bucket"
-                and set(dict(k)) == {"__series__", "le"}
-            ),
+        # the unlabeled aggregate row set (no size_class, no worker) —
+        # the cascade family is tier-labeled instead, one row per tier
+        variants = (
+            [("tier1", {"tier": "tier1"}), ("tier2", {"tier": "tier2"})]
+            if name == "roko_cascade_tier_seconds"
+            else [("", {})]
         )
-        if not buckets:
-            continue
-        p50 = quantile_from_buckets(buckets, 0.50)
-        p99 = quantile_from_buckets(buckets, 0.99)
+        for suffix, want in variants:
+            buckets = _hist_rows(rows, want)
+            if not buckets:
+                continue
+            shown = f"{name}{{{suffix}}}" if suffix else name
+            p50 = quantile_from_buckets(buckets, 0.50)
+            p99 = quantile_from_buckets(buckets, 0.99)
+            print(
+                f"{shown:<36} count={buckets[-1][1]:>7} "
+                f"p50~{_ms(p50)} p99~{_ms(p99)}"
+            )
+    windows = _counter_total(text, CASCADE_COUNTERS[0])
+    if windows:
+        escalated = _counter_total(text, CASCADE_COUNTERS[1]) or 0.0
+        hits = _counter_total(text, CASCADE_COUNTERS[2]) or 0.0
         print(
-            f"{name:<36} count={buckets[-1][1]:>7} "
-            f"p50~{_ms(p50)} p99~{_ms(p99)}"
+            f"cascade: windows={windows:.0f} "
+            f"escalation_fraction={escalated / windows:.3f} "
+            f"cache_hit_rate={hits / windows:.3f}"
         )
 
 
