@@ -361,6 +361,25 @@ def bench_torch_reference(iters: int = TORCH_ITERS, batch: int = 128) -> float:
 SWEEP_BATCHES = (BATCH, 2048)
 
 
+def _pallas_fallback(kind: str) -> str:
+    """Off-TPU ``use_pallas`` falls back to the scan path inside the
+    model (models/gru.py ``_pallas_backend``); a bench row timed there
+    would re-measure the scan under a pallas name. Emit ONE structured
+    event naming the fallback (the PR 14 anti-fork rule: every ROKO_*
+    line goes through obs.events.emit) and return the row's error
+    string so the artifact records it too."""
+    from roko_tpu.obs import events as obs_events
+
+    obs_events.emit(
+        "bench", "pallas_fallback",
+        text=f"bench: use_pallas on a non-TPU backend falls back to the "
+        f"{kind} scan path — skipping the pallas row instead of "
+        "re-timing the scan under a pallas name",
+        kind=kind,
+    )
+    return "pallas kernels need a TPU backend (scan-path fallback)"
+
+
 def run_inference_suite(
     batch: Optional[int] = None, progress=None,
     iters: Optional[int] = None,
@@ -465,6 +484,27 @@ def run_inference_suite(
         lin_row["warmup_seconds"] = d_l.get("warmup_seconds")
     except Exception as e:  # report, never swallow
         lin_row["error"] = f"{type(e).__name__}: {e}"[:300]
+    # fused Pallas lingru column (ISSUE 17): same never-swallowed
+    # contract as the GRU sweep — on TPU the row measures the fused
+    # kernel, off TPU it records the structured fallback instead of
+    # silently re-timing the scan path under a pallas name
+    if on_tpu:
+        try:
+            d_lp: Dict[str, Any] = {}
+            lin_row["pallas_windows_per_sec"] = round(
+                bench_infer(
+                    ModelConfig(
+                        kind="lingru", compute_dtype=dtype, use_pallas=True
+                    ),
+                    b0, iters, detail=d_lp,
+                ),
+                1,
+            )
+            lin_row["pallas_warmup_seconds"] = d_lp.get("warmup_seconds")
+        except Exception as e:  # report, never swallow
+            lin_row["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    else:
+        lin_row["pallas_error"] = _pallas_fallback("lingru")
     kinds["lingru"] = lin_row
     if progress is not None:
         progress(detail)
@@ -594,7 +634,7 @@ def run_train_suite(
             compute_dtype=dtype, use_pallas=True
         )
     else:
-        out["train_gru_pallas"] = {"error": "pallas kernels need a TPU backend"}
+        out["train_gru_pallas"] = {"error": _pallas_fallback("gru")}
     for name, cfg in suites.items():
         if budget_s is not None and time.perf_counter() - t0 > budget_s:
             out[name] = {"error": f"skipped: {budget_s:.0f}s bench budget spent"}
@@ -1251,9 +1291,16 @@ def compare_to_previous(
     }
     for kind, row in (cur_d.get("model_kinds") or {}).items():
         prow = (prev_d.get("model_kinds") or {}).get(kind) or {}
-        pairs[f"model_kinds.{kind}.scan_windows_per_sec"] = (
-            (row or {}).get("scan_windows_per_sec"),
-            prow.get("scan_windows_per_sec"),
+        for col in ("scan_windows_per_sec", "pallas_windows_per_sec"):
+            pairs[f"model_kinds.{kind}.{col}"] = (
+                (row or {}).get(col), prow.get(col),
+            )
+    # ragged-vs-continuous serve rows (ISSUE 17): padding efficiency +
+    # req/s of the masked top-rung path, same noise discipline
+    for col in ("padding_efficiency", "req_per_s", "req_per_s_vs_continuous"):
+        pairs[f"serve.ragged.{col}"] = (
+            ((cur_d.get("serve") or {}).get("ragged") or {}).get(col),
+            ((prev_d.get("serve") or {}).get("ragged") or {}).get(col),
         )
     # precision rows (ISSUE 11): the f32/bf16/int8 columns compare
     # cross-round on the same fixed work, same noise discipline
@@ -2301,7 +2348,7 @@ def run_serve_suite(
     from roko_tpu.models.model import RokoModel
     from roko_tpu.serve.batcher import MicroBatcher
     from roko_tpu.serve.metrics import ServeMetrics
-    from roko_tpu.serve.scheduler import ContinuousBatcher
+    from roko_tpu.serve.scheduler import ContinuousBatcher, RaggedBatcher
     from roko_tpu.serve.session import PolishSession
 
     mix = _parse_mix(mix_spec)
@@ -2333,8 +2380,9 @@ def run_serve_suite(
     def drive(mode: str, session=session, expected=expected) -> Dict[str, Any]:
         metrics = ServeMetrics()
         metrics.size_classes = ladder
-        if mode == "continuous":
-            batcher = ContinuousBatcher(
+        if mode in ("continuous", "ragged"):
+            cls = RaggedBatcher if mode == "ragged" else ContinuousBatcher
+            batcher = cls(
                 session, metrics=metrics, max_queue=clients * 2
             )
         else:
@@ -2411,8 +2459,9 @@ def run_serve_suite(
         "modes": {},
     }
     # calibration order fixed (deadline first) so cross-round artifacts
-    # compare like with like
-    for mode in ("deadline", "continuous"):
+    # compare like with like; "ragged" drives the same packing plane
+    # through the session's ONE masked top-rung executable (ISSUE 17)
+    for mode in ("deadline", "continuous", "ragged"):
         results["modes"][mode] = drive(mode)
     small = str(min(s for s, _ in mix))
     try:
@@ -2422,6 +2471,24 @@ def run_serve_suite(
             results["small_p99_improvement"] = round(d / c, 3)
     except KeyError:
         pass
+    # -- ragged vs continuous headline (ISSUE 17 acceptance): the same
+    # seeded schedule, padding efficiency and req/s side by side — the
+    # padded ladder's rung quantisation caps continuous near 0.96; the
+    # masked ragged step should read >= 0.99
+    rg = results["modes"].get("ragged") or {}
+    co = results["modes"].get("continuous") or {}
+    ragged_row: Dict[str, Any] = {
+        "padding_efficiency": rg.get("padding_efficiency"),
+        "continuous_padding_efficiency": co.get("padding_efficiency"),
+        "req_per_s": rg.get("req_per_s"),
+        "continuous_req_per_s": co.get("req_per_s"),
+        "byte_identical": rg.get("byte_identical"),
+    }
+    if rg.get("req_per_s") and co.get("req_per_s"):
+        ragged_row["req_per_s_vs_continuous"] = round(
+            rg["req_per_s"] / co["req_per_s"], 3
+        )
+    results["ragged"] = ragged_row
 
     # -- precision A/B row (ISSUE 11): the SAME seeded mixed schedule,
     # continuous mode, against sessions differing only in precision —

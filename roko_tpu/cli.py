@@ -786,6 +786,10 @@ def cmd_compile(args: argparse.Namespace) -> int:
     print(
         f"compile: wrote bundle {args.out} "
         f"(kind {cfg.model.kind}, "
+        # kernel path is identity too: pallas-vs-scan bundles refuse to
+        # cross-load (model.use_pallas field diff), so print which one
+        # this bundle was built for right beside the kind
+        f"pallas={str(ident_model.get('use_pallas', False)).lower()}, "
         f"compute_dtype={ident_model['compute_dtype']}, "
         f"quantize={ident_model['quantize'] or 'none'}, "
         # the mesh is identity: a bundle built for this shape refuses to
@@ -1516,13 +1520,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="micro-batch deadline from first queued request "
                    "(--batching deadline)")
     p.add_argument(
-        "--batching", choices=["continuous", "deadline"], default=None,
+        "--batching", choices=["continuous", "deadline", "ragged"],
+        default=None,
         help="batching policy (default continuous): 'continuous' packs "
         "windows from many requests densely into each ladder-rung device "
         "step and refills freed slots as requests complete — a small "
         "request never waits behind a large one; 'deadline' restores the "
-        "whole-request coalescer (right for single-tenant bulk polish; "
-        "docs/SERVING.md 'Continuous batching')",
+        "whole-request coalescer (right for single-tenant bulk polish); "
+        "'ragged' keeps the continuous packing but every step runs ONE "
+        "masked top-rung executable instead of padding to ladder rungs "
+        "(docs/SERVING.md 'Ragged dispatch')",
     )
     p.add_argument(
         "--max-queue-age-ms", type=float, default=None,

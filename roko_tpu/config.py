@@ -121,7 +121,13 @@ class ModelConfig:
     # `roko-tpu compile --quantize int8` builds an AOT bundle, whose
     # digest then covers this field — models/quant.py)
     quantize: Optional[str] = None
-    # use the Pallas fused GRU kernel when running on TPU
+    # use the fused Pallas kernels when running on TPU: the GRU
+    # recurrence (models/pallas_gru.py) for kind="gru", the fused
+    # log-depth scan (models/pallas_lingru.py) for kind="lingru".
+    # Participates in the AOT bundle identity like every other model
+    # field, so a pallas bundle refuses to load into a scan session.
+    # Off-TPU the scan path runs instead (ROKO_PALLAS_INTERPRET=1
+    # forces the interpret-mode kernels for CPU parity tests).
     use_pallas: bool = False
     # rematerialise the embed->fc2 front-end in the training backward
     # (jax.checkpoint): trades ~3 ms of recompute for ~1.8 GB of stored
@@ -264,8 +270,12 @@ class MeshConfig:
 #: freed capacity the moment earlier requests complete (batch shape
 #: decoupled from request boundaries — serve/scheduler.py); "deadline"
 #: is the classic whole-request coalescer (serve/batcher.py), still the
-#: right call for single-tenant bulk polish (docs/SERVING.md)
-BATCHING_MODES = ("continuous", "deadline")
+#: right call for single-tenant bulk polish (docs/SERVING.md);
+#: "ragged" drives the continuous packing plane but dispatches every
+#: step at the TOP rung with an explicit valid-row count the device
+#: masks — one executable, no padded-rung ladder, no rung-upgrade
+#: heuristics (docs/SERVING.md "Ragged dispatch")
+BATCHING_MODES = ("continuous", "deadline", "ragged")
 
 
 @dataclass(frozen=True)

@@ -105,6 +105,38 @@ def make_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
     return step
 
 
+def make_ragged_predict_step(model: RokoModel, mesh: Mesh) -> Callable:
+    """Ragged twin of :func:`make_predict_step`: ONE top-rung executable
+    whose batch axis is always the full slab, plus a scalar valid-row
+    count ``n`` — rows at or beyond ``n`` are zero-masked on device, so
+    the program output is bit-identical to padding the first ``n`` rows
+    with zeros (``pad_windows`` pads with zeros, which is what makes the
+    ragged and padded paths byte-identical by construction).
+
+    The scheduler packs segments densely from row 0 (serve/scheduler.py
+    ``RaggedBatcher``), so the per-segment length/offset vector reduces
+    to the single boundary ``n = sum(lengths)``: one scalar the kernel
+    masks on, not a recompile per occupancy. On the Pallas path the mask
+    is what lets row blocks past ``n`` skip their serial chains; under
+    XLA it is a cheap select. ``n`` rides as a traced scalar — changing
+    occupancy NEVER changes the executable."""
+    data = data_sharding(mesh)
+
+    @partial(
+        jax.jit, in_shardings=(None, data, None), out_shardings=data
+    )
+    def step(params, x, n):
+        mask = jnp.arange(x.shape[0]) < n
+        x = jnp.where(
+            mask.reshape((-1,) + (1,) * (x.ndim - 1)), x,
+            jnp.zeros((), x.dtype),
+        )
+        logits = model.apply(params, x, deterministic=True)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    return step
+
+
 def make_cpu_predict(model: RokoModel, params_host: Params) -> Callable:
     """Host-CPU predict closure for watchdog fail-over
     (roko_tpu/resilience): same forward + argmax as

@@ -185,11 +185,19 @@ class RokoLinGRU:
     """Functional container mirroring :class:`~roko_tpu.models.gru.RokoGRU`:
     builds/holds no state, just init + apply."""
 
-    def __init__(self, in_size: int, hidden: int, num_layers: int, dropout: float):
+    def __init__(
+        self,
+        in_size: int,
+        hidden: int,
+        num_layers: int,
+        dropout: float,
+        use_pallas: bool = False,
+    ):
         self.in_size = in_size
         self.hidden = hidden
         self.num_layers = num_layers
         self.dropout = dropout
+        self.use_pallas = use_pallas
 
     def init(self, rng: jax.Array, dtype=jnp.float32) -> Tuple[Dict[str, Any], ...]:
         layers = []
@@ -205,6 +213,36 @@ class RokoLinGRU:
         return tuple(layers)
 
     def apply(self, params, x, *, deterministic=True, rng=None):
+        # Fused Pallas scan kernel (models/pallas_lingru.py): covers
+        # inference AND training (custom VJP recomputes the gates in
+        # the backward; dropout lives between layers, outside the
+        # kernel). Off-TPU the flag falls back to the associative-scan
+        # path — interpret-mode Pallas is orders of magnitude slower —
+        # unless ROKO_PALLAS_INTERPRET=1 forces the interpret kernels
+        # (the tier-1 CI story: full-model pallas parity without a
+        # TPU). Callers that need the fallback to be loud observe it
+        # at the dispatch site (benchmark emits a structured event).
+        if self.use_pallas:
+            import os
+
+            from roko_tpu.models.gru import _pallas_backend
+
+            interpret = os.environ.get("ROKO_PALLAS_INTERPRET") == "1"
+            if _pallas_backend() or interpret:
+                from roko_tpu.models.pallas_lingru import (
+                    bidir_lingru_stack_pallas,
+                )
+
+                # int8 weight-only params dequantize inside the layer's
+                # projection via layers.weight(), same as the scan path
+                return bidir_lingru_stack_pallas(
+                    params,
+                    x,
+                    dropout=self.dropout,
+                    deterministic=deterministic,
+                    rng=rng,
+                    interpret=interpret and jax.default_backend() != "tpu",
+                )
         return bidir_lingru_stack(
             params,
             x,

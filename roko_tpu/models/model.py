@@ -84,6 +84,7 @@ class RokoModel:
             self.cfg.hidden_size,
             self.cfg.num_layers,
             self.cfg.dropout,
+            use_pallas=self.cfg.use_pallas,
         )
 
     # -- init ---------------------------------------------------------------

@@ -1,0 +1,316 @@
+"""Fused Pallas TPU kernel for the lingru linear recurrence — fwd + bwd.
+
+The ``kind="lingru"`` layer (models/lingru.py) hoists all arithmetic
+density into one [B*T, in] x [in, 4H] MXU matmul and leaves a purely
+elementwise affine recurrence ``h_t = a_t*h_{t-1} + b_t`` to
+``lax.associative_scan``. XLA's generic scan materialises every
+log-depth round trip through HBM: ~2*log2(T) full [2,B,T,H] tensors
+read AND written per layer. This module fuses the whole tail — the
+sigmoid/tanh gate math, the log-depth scan, and the ``(1-z)*h + z*c``
+recombination — into ONE VMEM-resident Pallas launch per layer, with
+both directions of the bidirectional stack solved in the same pass
+(the lingru trick of stacking the time-reversed backward direction as
+extra batch rows, models/lingru.py ``bidir_lingru_layer``).
+
+Design (mirrors the proven ``pallas_gru.py`` v3 shape):
+
+- **Time-only serial grid.** The TPU walks the grid sequentially, so
+  the affine carry ``(h at the block boundary)`` lives in f32 VMEM
+  scratch across grid steps. All ``S*B`` direction-stacked rows stay
+  resident; time is the only grid axis, blocked to fit VMEM with the
+  next block's DMA double-buffered behind the current block's compute.
+- **In-block log-depth scan.** Each time block runs a Hillis-Steele
+  inclusive scan over the affine pairs ``(a, b)`` — a static Python
+  loop of ceil(log2(t_blk)) masked-shift rounds, entirely in VMEM —
+  then applies the composed maps to the carried boundary state:
+  ``h = A*carry + B``. Serial depth per layer is nt + log2(t_blk)
+  elementwise rounds with zero HBM traffic in between.
+- **Gates recomputed in the backward** (the kernel analogue of
+  ``ModelConfig.remat_scan``): the custom VJP stores only the layer
+  inputs/outputs the caller keeps anyway (the gate projections ``p``
+  and hidden states ``h``) and recomputes z/c in-kernel. The upstream
+  recurrence ``g_t = dy_t + a_{t+1}*g_{t+1}`` is rewritten through
+  ``e_t = a_t*g_t`` as the suffix affine scan
+  ``e_t = a_t*e_{t+1} + a_t*dy_t`` — coefficients indexed WITHIN each
+  step, so the same log-depth machinery runs time-reversed with a
+  clean e-carry across blocks, and ``g_t = dy_t + e_{t+1}`` falls out
+  by a one-row shift. ``h_{t-1}`` at block boundaries streams in as a
+  strided slice of the stored states (one row per block, the
+  ``pallas_gru`` boundary-row idiom).
+- **The projection matmul stays outside.** ``x @ W4 + b4`` (and its
+  dW4/dx/db4 grads) remain plain XLA GEMMs; the custom VJP covers only
+  the scan tail, which is exactly the part XLA schedules badly.
+
+Numerics: gates and scan always accumulate in float32 regardless of
+the input dtype; outputs cast back. ``interpret=True`` runs the same
+kernels on CPU — tier-1 pins fwd AND grad parity against
+``linear_scan_ref``/``bidir_lingru_stack`` at 1e-5 without a TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from roko_tpu.models.layers import dropout as _dropout, weight as _weight
+
+# VMEM working-set budget per kernel invocation (double-buffered blocks
+# included) — same figure pallas_gru uses; the guide says ~16 MB/core.
+_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _round_up(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _pick_tblk(T: int, rows: int, hidden: int, itemsize: int, bwd: bool) -> int:
+    """Largest divisor-of-T time block whose working set fits VMEM.
+
+    Streamed per (time, row): fwd reads p[2H] and writes h[H]; bwd
+    reads p[2H]+h[H]+dy[H] and writes dp[2H] (+1H boundary slack).
+    The Hillis-Steele rounds keep ~4 extra f32 (t_blk, rows, H)
+    temporaries alive (A, B and their shifted copies), and the f32
+    carry scratch is resident across grid steps. t_blk=1 always
+    "fits" — it degrades to a serial per-step recurrence, still one
+    launch."""
+    per_row = (7 if bwd else 3) * hidden * itemsize  # double-buffered streams
+    scan_tmp = 4 * hidden * 4  # f32 scan temporaries per (time, row)
+    resident = rows * hidden * 4 * (2 if bwd else 1)  # carry scratch
+    for t_blk in (d for d in range(T, 0, -1) if T % d == 0):
+        if t_blk * rows * (2 * per_row + scan_tmp) + resident <= _VMEM_BUDGET:
+            return t_blk
+    return 1
+
+
+def _fwd_kernel(t_blk: int, hidden: int, out_dtype):
+    """p block (t_blk, R, 2H) -> h block (t_blk, R, H); f32 carry (R, H)
+    persists across the sequential time grid."""
+
+    def kernel(p_ref, h_ref, carry):
+        @pl.when(pl.program_id(0) == 0)
+        def _init():
+            carry[...] = jnp.zeros_like(carry)
+
+        pf = p_ref[...].astype(jnp.float32)
+        z = jax.nn.sigmoid(pf[..., :hidden])
+        c = jnp.tanh(pf[..., hidden:])
+        A = 1.0 - z
+        B = z * c
+        # Hillis-Steele inclusive scan over the block's leading time
+        # axis: element t composes with element t-d under the affine
+        # combine (A_l, B_l) o (A_r, B_r) = (A_l*A_r, B_l*A_r + B_r)
+        # (left = earlier), identity-padded at the top.
+        d = 1
+        while d < t_blk:
+            A_s = jnp.concatenate([jnp.ones_like(A[:d]), A[:-d]], axis=0)
+            B_s = jnp.concatenate([jnp.zeros_like(B[:d]), B[:-d]], axis=0)
+            A, B = A_s * A, B_s * A + B
+            d *= 2
+        h = A * carry[...][None] + B
+        h_ref[...] = h.astype(out_dtype)
+        carry[...] = h[-1]
+
+    return kernel
+
+
+def _bwd_kernel(t_blk: int, nt: int, hidden: int):
+    """Reverse-time pass: grid step k visits time block nt-1-k.
+
+    Computes dL/dp for the block from (p, h, dy) via the e-scan
+    (module docstring); ``hb_ref`` carries the previous block's last
+    hidden row (h_{t-1} across the block boundary), zeros at global
+    t=0."""
+
+    def kernel(p_ref, h_ref, dy_ref, hb_ref, dp_ref, ecarry):
+        k = pl.program_id(0)
+
+        @pl.when(k == 0)
+        def _init():  # e_T = 0: g at the global last step is just dy
+            ecarry[...] = jnp.zeros_like(ecarry)
+
+        pf = p_ref[...].astype(jnp.float32)
+        z = jax.nn.sigmoid(pf[..., :hidden])
+        c = jnp.tanh(pf[..., hidden:])
+        a = 1.0 - z
+        dy = dy_ref[...].astype(jnp.float32)
+        e_in = ecarry[...]  # e_{t+1} at this block's LAST index
+        # suffix affine scan e_t = a_t*e_{t+1} + a_t*dy_t: element t
+        # composes with element t+d — (A_l, B_l) o (A_r, B_r) =
+        # (A_l*A_r, A_l*B_r + B_l), identity-padded at the bottom.
+        A = a
+        B = a * dy
+        d = 1
+        while d < t_blk:
+            A_s = jnp.concatenate([A[d:], jnp.ones_like(A[:d])], axis=0)
+            B_s = jnp.concatenate([B[d:], jnp.zeros_like(B[:d])], axis=0)
+            A, B = A * A_s, A * B_s + B
+            d *= 2
+        e = A * e_in[None] + B
+        ecarry[...] = e[0]  # e_{t+1} for the previous block's last row
+        e_next = jnp.concatenate([e[1:], e_in[None]], axis=0)
+        g = dy + e_next  # total grad into h_t
+        hf = h_ref[...].astype(jnp.float32)
+        # h_{t-1}: in-block shift + streamed boundary row (zeros at the
+        # global first block, which the reverse grid visits LAST)
+        not_first = jnp.where(k == nt - 1, 0.0, 1.0)
+        h_prev0 = hb_ref[...].astype(jnp.float32) * not_first
+        h_prev = jnp.concatenate([h_prev0, hf[:-1]], axis=0)
+        da = g * h_prev  # h_t = a_t*h_{t-1} + b_t
+        dz = g * c - da  # a = 1-z, b = z*c
+        dc = g * z
+        dpz = dz * (z * (1.0 - z))
+        dpc = dc * (1.0 - c * c)
+        dp_ref[...] = jnp.concatenate([dpz, dpc], axis=-1).astype(dp_ref.dtype)
+
+    return kernel
+
+
+def _run_fwd(p: jax.Array, interpret: bool) -> jax.Array:
+    T, R, H2 = p.shape
+    hidden = H2 // 2
+    t_blk = _pick_tblk(T, R, hidden, p.dtype.itemsize, bwd=False)
+    nt = T // t_blk
+    return pl.pallas_call(
+        _fwd_kernel(t_blk, hidden, p.dtype),
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec(
+                (t_blk, R, H2), lambda k: (k, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (t_blk, R, hidden), lambda k: (k, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((T, R, hidden), p.dtype),
+        scratch_shapes=[pltpu.VMEM((R, hidden), jnp.float32)],
+        interpret=interpret,
+    )(p)
+
+
+def _run_bwd(
+    p: jax.Array, h: jax.Array, dy: jax.Array, interpret: bool
+) -> jax.Array:
+    T, R, H2 = p.shape
+    hidden = H2 // 2
+    t_blk = _pick_tblk(T, R, hidden, p.dtype.itemsize, bwd=True)
+    nt = T // t_blk
+    # one boundary row per time block: h at each block's last index
+    hb = h[t_blk - 1 :: t_blk]  # (nt, R, H)
+    rev = lambda k: (nt - 1 - k, 0, 0)  # noqa: E731 — reverse time walk
+    spec = lambda w: pl.BlockSpec(  # noqa: E731
+        (t_blk, R, w), rev, memory_space=pltpu.VMEM
+    )
+    return pl.pallas_call(
+        _bwd_kernel(t_blk, nt, hidden),
+        grid=(nt,),
+        in_specs=[
+            spec(H2),  # p
+            spec(hidden),  # h
+            spec(hidden),  # dy
+            pl.BlockSpec(
+                (1, R, hidden),
+                lambda k: (jnp.maximum(nt - 2 - k, 0), 0, 0),
+                memory_space=pltpu.VMEM,
+            ),  # boundary rows (unused at the global first block)
+        ],
+        out_specs=spec(H2),
+        out_shape=jax.ShapeDtypeStruct((T, R, H2), p.dtype),
+        scratch_shapes=[pltpu.VMEM((R, hidden), jnp.float32)],
+        interpret=interpret,
+    )(p, h, dy, hb)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def lingru_scan_pallas(static: Tuple[bool], p: jax.Array) -> jax.Array:
+    """Fused gate + log-depth scan over stacked projections.
+
+    ``p`` is time-major [T, R, 2H] — R direction-stacked (and
+    row-padded) batch rows, last axis the raw (z, c) gate projections.
+    Returns h [T, R, H]. ``static = (interpret,)``."""
+    (interpret,) = static
+    return _run_fwd(p, interpret)
+
+
+def _scan_vjp_fwd(static, p):
+    (interpret,) = static
+    h = _run_fwd(p, interpret)
+    return h, (p, h)
+
+
+def _scan_vjp_bwd(static, res, dy):
+    (interpret,) = static
+    p, h = res
+    return (_run_bwd(p, h, dy, interpret),)
+
+
+lingru_scan_pallas.defvjp(_scan_vjp_fwd, _scan_vjp_bwd)
+
+
+def bidir_lingru_layer_pallas(
+    layer: Dict[str, Any], x: jax.Array, *, interpret: bool = False
+) -> jax.Array:
+    """Both directions of one lingru layer, [B,T,in] -> [B,T,2H] — the
+    fused-kernel twin of ``lingru.bidir_lingru_layer``. Same one
+    [B*T, in] x [in, 4H] projection matmul outside the kernel; the
+    backward direction rides as time-reversed extra rows so ONE launch
+    solves both recurrences."""
+    w_zx_f = _weight(layer["fwd"]["w_zx"], x.dtype)
+    hidden = w_zx_f.shape[1]
+    w4 = jnp.concatenate(
+        [
+            w_zx_f, _weight(layer["fwd"]["w_cx"], x.dtype),
+            _weight(layer["bwd"]["w_zx"], x.dtype),
+            _weight(layer["bwd"]["w_cx"], x.dtype),
+        ],
+        axis=1,
+    )
+    b4 = jnp.concatenate(
+        [
+            layer["fwd"]["b_z"], layer["fwd"]["b_c"],
+            layer["bwd"]["b_z"], layer["bwd"]["b_c"],
+        ]
+    )
+    proj = x @ w4 + b4  # [B,T,4H]
+    B, T = x.shape[0], x.shape[1]
+    Bp = _round_up(max(B, 1), 8)  # f32 sublane tile
+    p_f = proj[..., : 2 * hidden]
+    p_b = jnp.flip(proj[..., 2 * hidden :], axis=1)
+
+    def _pad(rows):  # zero rows scan to h=0 and drop at the slice below
+        return jnp.pad(rows, ((0, Bp - B), (0, 0), (0, 0)))
+
+    pstack = jnp.concatenate([_pad(p_f), _pad(p_b)], axis=0)  # (2Bp,T,2H)
+    pstack = pstack.swapaxes(0, 1)  # time-major (T, 2Bp, 2H)
+    hs = lingru_scan_pallas((bool(interpret),), pstack)  # (T, 2Bp, H)
+    h_f = hs[:, :B].swapaxes(0, 1)
+    h_b = jnp.flip(hs[:, Bp : Bp + B].swapaxes(0, 1), axis=1)
+    return jnp.concatenate([h_f, h_b], axis=-1)  # [B,T,2H]
+
+
+def bidir_lingru_stack_pallas(
+    params: Tuple[Dict[str, Any], ...],
+    x: jax.Array,
+    *,
+    dropout: float = 0.0,
+    deterministic: bool = True,
+    rng: jax.Array | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Stacked bidirectional lingru on the fused kernel, [B,T,in] ->
+    [B,T,2H]. Inter-layer dropout stays outside the kernels, matching
+    ``lingru.bidir_lingru_stack`` (and torch) placement."""
+    num_layers = len(params)
+    for i, layer in enumerate(params):
+        x = bidir_lingru_layer_pallas(layer, x, interpret=interpret)
+        if dropout > 0.0 and not deterministic and i < num_layers - 1:
+            assert rng is not None
+            rng, sub = jax.random.split(rng)
+            x = _dropout(sub, x, dropout)
+    return x

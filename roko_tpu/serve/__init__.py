@@ -56,7 +56,7 @@ from roko_tpu.serve.rollout import (
     RolloutJournal,
     recover_rollout,
 )
-from roko_tpu.serve.scheduler import ContinuousBatcher
+from roko_tpu.serve.scheduler import ContinuousBatcher, RaggedBatcher
 from roko_tpu.serve.server import drain, make_server, serve_forever
 from roko_tpu.serve.session import PolishSession
 from roko_tpu.serve.supervisor import make_front_server, run_supervisor
@@ -69,6 +69,7 @@ __all__ = [
     "MicroBatcher",
     "PolishClient",
     "PolishSession",
+    "RaggedBatcher",
     "RegistryError",
     "RegistryMismatch",
     "RolloutController",

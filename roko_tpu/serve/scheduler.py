@@ -433,6 +433,18 @@ class ContinuousBatcher:
         self._pool = [s for s in self._pool if s.next < s.n]
         return spans
 
+    def _predict_slab(self, total: int) -> np.ndarray:
+        """Device step for ``total`` densely packed slab rows — the one
+        seam :class:`RaggedBatcher` overrides (ladder-padded here,
+        masked top-rung ragged there)."""
+        return self.session.predict(self._slab[:total])
+
+    def _device_slots(self, total: int) -> int:
+        """Device slots the step actually paid for — denominates the
+        batch-fill / padding-efficiency metrics (padded rung size here,
+        dp-granular mask occupancy on the ragged path)."""
+        return self.session.padded_size(total)
+
     def _dispatch(self, spans: List[Span]) -> None:
         """One packed device step: copy spans densely into the slot
         slab, predict (``PolishSession`` pads to the ladder — only
@@ -451,7 +463,7 @@ class ContinuousBatcher:
             self._slab[off : off + count] = slot.x[src : src + count]
         t0 = time.perf_counter()
         try:
-            preds = self.session.predict(self._slab[:total])
+            preds = self._predict_slab(total)
         except BaseException as e:
             if self.breaker is not None:
                 if isinstance(e, _REQUEST_ERRORS):
@@ -478,7 +490,7 @@ class ContinuousBatcher:
         dt = time.perf_counter() - t0
         if self.breaker is not None:
             self.breaker.record_success()
-        rung = max(1, self.session.padded_size(total))
+        rung = max(1, self._device_slots(total))
         dp = getattr(self.session, "dp", 1)
         self._steps += 1
         step_id = self._steps
@@ -550,3 +562,50 @@ class ContinuousBatcher:
                 if spans is None:  # stopped
                     return
             self._dispatch(spans)
+
+
+class RaggedBatcher(ContinuousBatcher):
+    """Ragged packed dispatch (``ServeConfig.batching == "ragged"``,
+    docs/SERVING.md "Ragged dispatch"): the same slot pool, fair-share
+    packing, segment scatter, backpressure, and breaker plumbing as
+    :class:`ContinuousBatcher`, but every device step runs the session's
+    ONE top-rung ragged executable with an explicit valid-row count
+    instead of padding to a ladder rung.
+
+    What that deletes from the scheduling policy: the padded path's
+    steps 2-3 (rung-upgrade hysteresis and the full-smaller-rung
+    split) exist only to trade padding waste against batch size — with
+    a masked step there is no padded rung to waste, so
+    ``rung_upgrade_fill`` is dead config on this path and ``_plan``
+    reduces to *full top rung or age flush*. Occupancy accounting is
+    dp-granular (``PolishSession.ragged_slots``): the shared
+    ``padding_efficiency`` metric reads real windows / masked slots and
+    sits at ~1.0 where the ladder path is rung-quantised to ~0.96."""
+
+    BATCHING_MODE = "ragged"
+
+    def _predict_slab(self, total: int) -> np.ndarray:
+        # full slab, not a [:total] view: the shape is always the top
+        # rung, and the device masks rows at/past `total` (stale slab
+        # rows never reach the model)
+        return self.session.predict_ragged(self._slab, total)
+
+    def _device_slots(self, total: int) -> int:
+        return self.session.ragged_slots(total)
+
+    def _plan(self, now: float) -> Tuple[Optional[int], Optional[float]]:
+        """Full top rung, else wait for arrivals until the oldest
+        queued window hits ``max_queue_age_ms``, then dispatch exactly
+        the pending count — the padded path's policy steps 1 and 4 with
+        the padding-driven middle steps removed."""
+        pending = sum(s.n - s.next for s in self._pool)
+        if pending == 0:
+            return None, None
+        top = self.session.ladder[-1]
+        if pending >= top:
+            return top, None
+        oldest = min(s.t_submit for s in self._pool if s.next < s.n)
+        age_left = self.max_queue_age_s - (now - oldest)
+        if age_left <= 0:
+            return pending, None
+        return None, age_left

@@ -792,10 +792,21 @@ def make_server(
         # coalescer. Knobs come from the EXPLICIT serve_cfg — the
         # batchers' own defaults read session.cfg.serve, which may be a
         # different config object than the one passed here.
-        if serve_cfg.batching == "continuous":
-            from roko_tpu.serve.scheduler import ContinuousBatcher
+        if serve_cfg.batching in ("continuous", "ragged"):
+            from roko_tpu.serve.scheduler import (
+                ContinuousBatcher,
+                RaggedBatcher,
+            )
 
-            batcher = ContinuousBatcher(
+            # "ragged" rides the same packing plane; its steps run the
+            # session's one masked top-rung executable instead of the
+            # padded ladder (docs/SERVING.md "Ragged dispatch")
+            cls = (
+                RaggedBatcher
+                if serve_cfg.batching == "ragged"
+                else ContinuousBatcher
+            )
+            batcher = cls(
                 session,
                 metrics=metrics,
                 breaker=breaker,

@@ -31,6 +31,7 @@ from roko_tpu.config import RokoConfig, resolve_ladder, validate_ladder
 from roko_tpu.infer import (
     make_cpu_predict,
     make_predict_step,
+    make_ragged_predict_step,
     pad_windows,
     rung_for,
 )
@@ -106,6 +107,19 @@ class PolishSession:
         self._cpu_predict = None  # built on first fail-over
         self.params = jax.device_put(params, replicated_sharding(self.mesh))
         self._step = make_predict_step(self.model, self.mesh)
+        #: ragged dispatch (ServeConfig.batching == "ragged",
+        #: docs/SERVING.md "Ragged dispatch"): every device step runs
+        #: ONE top-rung executable with an explicit valid-row count the
+        #: device masks — no padded-rung ladder, no per-rung compiles
+        self.ragged: bool = self.cfg.serve.batching == "ragged"
+        # built eagerly in ragged mode (warmup compiles through it) and
+        # lazily otherwise, so one warm session can be driven by either
+        # batcher — the byte-identity gates depend on that
+        self._ragged_step = (
+            make_ragged_predict_step(self.model, self.mesh)
+            if self.ragged
+            else None
+        )
         self._sharding = data_sharding(self.mesh)
         self._lock = threading.Lock()
         #: padded batch sizes that have reached the device — after
@@ -131,11 +145,15 @@ class PolishSession:
     # -- compile accounting -------------------------------------------------
 
     def cache_size(self) -> int:
-        """jit-cache entry count for the predict step (one per compiled
-        batch shape); falls back to the dispatched-shape count if the
-        private jax API ever disappears."""
+        """jit-cache entry count for the predict step(s) (one per
+        compiled batch shape; the ragged step only ever holds one entry
+        — occupancy is a traced scalar); falls back to the
+        dispatched-shape count if the private jax API ever disappears."""
         try:
-            return int(self._step._cache_size())
+            n = int(self._step._cache_size())
+            if self._ragged_step is not None:
+                n += int(self._ragged_step._cache_size())
+            return n
         except AttributeError:  # pragma: no cover - jax version drift
             return len(self.dispatched_shapes)
 
@@ -171,6 +189,34 @@ class PolishSession:
         ccfg = self.cfg.compile
         bundle_dir = ccfg.bundle_dir if bundle_dir is None else bundle_dir
         parallel = ccfg.parallel_warmup if parallel is None else parallel
+        if self.ragged:
+            # ragged mode compiles ONE top-rung executable (occupancy is
+            # a traced scalar, never a shape) — the padded-rung ladder
+            # and any AOT bundle of it simply do not apply. A configured
+            # bundle is reported loudly rather than half-loaded: its
+            # executables have the padded (params, x) signature, not the
+            # ragged (params, x, n) one.
+            if bundle_dir:
+                obs_events.emit(
+                    "serve", "ragged_bundle_skipped",
+                    text="serve: batching=ragged ignores the AOT bundle "
+                    f"at {bundle_dir} — ragged steps compile one "
+                    "(params, x, n) executable via the persistent "
+                    "cache; padded-ladder bundles cannot serve them",
+                    stage="warmup",
+                )
+            top = self.ladder[-1]
+
+            def compile_ragged(rung: int) -> None:
+                self._dispatch_ragged(
+                    np.zeros((rung,) + self._window_shape, np.uint8), 0
+                )
+
+            self.warmup_report = warmup_ladder(
+                [top], compile_ragged, parallel=False, mode="ragged",
+                log=log,
+            )
+            return self.ready_executables()
         mode = None
         if bundle_dir:
             # require_all=False is the streaming-polish posture: rungs
@@ -282,6 +328,91 @@ class PolishSession:
                 self.model, self._params_host
             )
             return self._cpu_predict(x)
+
+    # -- ragged dispatch ----------------------------------------------------
+
+    def ragged_slots(self, n: int) -> int:
+        """Device slots an n-window ragged step actually spends compute
+        on: the mask boundary rounds up to the dp shard granularity
+        (each of the dp shards masks its own rows, so occupancy is
+        denominated in dp-row units). This is the ragged analogue of
+        ``padded_size`` and feeds the same padding-efficiency metric —
+        real windows / ragged_slots -> 1.0 as packing densifies, vs the
+        padded ladder's rung-quantised ~0.96 ceiling."""
+        return -(-n // self.dp) * self.dp
+
+    def _dispatch_ragged(self, x: np.ndarray, n: int) -> np.ndarray:
+        """One top-rung slab + valid-row count through the ragged
+        executable, under the same resilience watchdog as ``_dispatch``.
+        After a CPU fail-over the mask applies host-side (zeros beyond
+        ``n`` — exactly what the device mask computes), so the degraded
+        path stays byte-identical too."""
+        self.dispatched_shapes.add(x.shape[0])
+        if self._cpu_predict is not None:
+            return self._cpu_predict(self._mask_rows(x, n))
+        if self._ragged_step is None:
+            self._ragged_step = make_ragged_predict_step(
+                self.model, self.mesh
+            )
+        step = self._ragged_step
+
+        def run() -> np.ndarray:
+            fut = step(
+                self.params, jax.device_put(x, self._sharding), np.int32(n)
+            )
+            return np.asarray(jax.device_get(fut))
+
+        key = ("ragged", x.shape[0])
+        deadline_s, first = self._deadlines.deadline_for(key)
+        try:
+            try:
+                return call_with_deadline(
+                    run,
+                    deadline_s,
+                    stage="serve-compile" if first else "serve-predict",
+                )
+            except BaseException:
+                if first:
+                    self._deadlines.forget(key)
+                raise
+        except HangError:
+            if self.resilience.hang_fallback != "cpu":
+                raise
+            obs_events.emit(
+                "failover", "cpu_fallback",
+                text="serve: device hang — session permanently "
+                "failed over to host-CPU predict (degraded); healthz "
+                "cpu_fallback=true, metrics roko_serve_cpu_fallback=1",
+                stage="serve", shape=x.shape[0],
+            )
+            self._cpu_predict = make_cpu_predict(
+                self.model, self._params_host
+            )
+            return self._cpu_predict(self._mask_rows(x, n))
+
+    @staticmethod
+    def _mask_rows(x: np.ndarray, n: int) -> np.ndarray:
+        out = x.copy()
+        out[n:] = 0
+        return out
+
+    def predict_ragged(self, x: np.ndarray, n: int) -> np.ndarray:
+        """uint8[top, rows, cols] slab + valid-row count -> int32[n, cols]
+        class ids. The slab must already be top-rung shaped (the ragged
+        batcher owns slab packing); rows at or past ``n`` are masked on
+        device, so stale slab contents never reach the model."""
+        x = np.ascontiguousarray(x, dtype=np.uint8)
+        top = self.ladder[-1]
+        if x.ndim != 3 or x.shape != (top,) + self._window_shape:
+            raise ValueError(
+                f"ragged slab shaped {x.shape}, want "
+                f"{(top,) + self._window_shape}"
+            )
+        if not 0 <= n <= top:
+            raise ValueError(f"valid-row count {n} outside [0, {top}]")
+        with self._lock:
+            preds = self._dispatch_ragged(x, n)
+        return preds[:n]
 
     @property
     def failed_over(self) -> bool:

@@ -38,6 +38,7 @@ from roko_tpu.serve import (
     MicroBatcher,
     PolishClient,
     PolishSession,
+    RaggedBatcher,
     ServeMetrics,
     make_server,
 )
@@ -78,6 +79,29 @@ class FakeSession:
     def predict(self, x):
         self.dispatched.append(x.shape[0])
         return x.sum(axis=1, dtype=np.int64).astype(np.int32)
+
+
+class FakeRaggedSession(FakeSession):
+    """The ragged device contract without a device: takes the FULL
+    top-rung slab plus a valid count, masks rows at/past n exactly like
+    ``PolishSession.predict_ragged`` (stale slab rows never reach the
+    'model'), and returns the first n results. ``dispatched`` records
+    (slab_rows, n) pairs so tests can prove every launch was the one
+    top-rung shape."""
+
+    def __init__(self, ladder=(8, 16), dp=1):
+        super().__init__(ladder)
+        self.dp = dp
+
+    def ragged_slots(self, n):
+        return -(-n // self.dp) * self.dp
+
+    def predict_ragged(self, x, n):
+        assert x.shape[0] == self.ladder[-1], "always the top-rung slab"
+        self.dispatched.append((x.shape[0], n))
+        masked = x.copy()
+        masked[n:] = 0
+        return masked.sum(axis=1, dtype=np.int64).astype(np.int32)[:n]
 
 
 def _win(rng, n):
@@ -377,6 +401,138 @@ def test_metrics_padding_efficiency_and_size_classes(rng):
     assert metrics.size_class(40) == "gt16"
 
 
+# -- ragged packed dispatch policy units --------------------------------------
+
+
+def make_rb(session=None, **kw):
+    kw.setdefault("max_queue", 8)
+    kw.setdefault("max_queue_age_ms", 50.0)
+    kw.setdefault("rung_upgrade_fill", 0.75)
+    kw.setdefault("retry_after_s", 1.0)
+    kw.setdefault("start", False)
+    return RaggedBatcher(session or FakeRaggedSession(), **kw)
+
+
+def test_ragged_plan_full_top_rung(rng):
+    cb = make_rb()
+    cb.submit(_win(rng, 40))
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter())
+    assert k == 16  # backlog >= top rung: completely full top-rung step
+
+
+def test_ragged_plan_partial_waits_then_age_flushes_exact_count(rng):
+    """Below the top rung there is no rung ladder to round to: the plan
+    waits for arrivals, then the age flush dispatches EXACTLY the
+    pending count (no pad rows to amortise)."""
+    cb = make_rb(max_queue_age_ms=30.0)
+    cb.submit(_win(rng, 9))
+    with cb._cv:
+        k, wait = cb._plan(time.perf_counter())
+    assert k is None and 0 < wait <= 0.030
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter() + 0.040)
+    assert k == 9  # not 8, not 16: the mask absorbs the raggedness
+
+
+def test_ragged_rung_upgrade_hysteresis_is_dead(rng):
+    """The hysteresis knob exists to avoid paying for a half-empty
+    LARGER padded rung — meaningless when the device masks instead of
+    pads. Any rung_upgrade_fill plans identically."""
+    plans = []
+    for fill in (0.05, 0.75, 0.95):
+        cb = make_rb(rung_upgrade_fill=fill)
+        cb.submit(_win(rng, 13))  # 13 >= 0.75*16 would upgrade continuous
+        with cb._cv:
+            plans.append(cb._plan(time.perf_counter())[0])
+        with cb._cv:
+            plans.append(cb._plan(time.perf_counter() + 1.0)[0])
+    assert plans == [None, 13, None, 13, None, 13]
+
+
+def test_ragged_packing_results_scatter_correctly(rng):
+    """Mixed sizes through the ragged plane: every request's result
+    equals a solo compute of its own windows, even though every launch
+    ships the full top-rung slab with stale rows past the valid count
+    (the mask at the rung boundary is what keeps them out)."""
+    fake = FakeRaggedSession()
+    cb = make_rb(fake)
+    xs = [_win(rng, n) for n in (5, 11, 2, 16, 1)]
+    futs = [cb.submit(x) for x in xs]
+    for _ in range(10):
+        if all(f._req.done.is_set() for f in futs):
+            break
+        with cb._cv:
+            k, _ = cb._plan(time.perf_counter() + 1.0)
+            spans = cb._take(k) if k else None
+        if spans:
+            cb._dispatch(spans)
+    for x, f in zip(xs, futs):
+        np.testing.assert_array_equal(
+            f.result(0), x.sum(axis=1, dtype=np.int64).astype(np.int32)
+        )
+    # every device step was the one top-rung executable (zero recompile
+    # surface), with the valid count riding as data
+    assert all(slab == 16 for slab, _ in fake.dispatched)
+    assert sum(n for _, n in fake.dispatched) == sum(len(x) for x in xs)
+
+
+def test_ragged_fill_metrics_count_real_slots(rng):
+    """padding_efficiency denominates in dp-granular mask slots, not
+    padded rung rows: dp=1 is perfect fill by construction, dp=8
+    charges the shard-granularity remainder honestly."""
+    metrics = ServeMetrics()
+    cb = make_rb(FakeRaggedSession(dp=1), metrics=metrics)
+    cb.submit(_win(rng, 16)), cb.submit(_win(rng, 3))
+    step(cb)
+    with cb._cv:
+        k, _ = cb._plan(time.perf_counter() + 1.0)
+        spans = cb._take(k)
+    cb._dispatch(spans)
+    assert metrics.fill_totals() == (19, 19)
+    assert metrics.fill_ratio() == pytest.approx(1.0)
+
+    metrics8 = ServeMetrics()
+    cb8 = make_rb(FakeRaggedSession(dp=8), metrics=metrics8)
+    cb8.submit(_win(rng, 16)), cb8.submit(_win(rng, 3))
+    step(cb8)
+    with cb8._cv:
+        k, _ = cb8._plan(time.perf_counter() + 1.0)
+        spans = cb8._take(k)
+    cb8._dispatch(spans)
+    assert metrics8.fill_totals() == (19, 24)  # 16/16 + 3/8
+
+
+def test_ragged_small_never_waits_behind_large(rng):
+    """Head-of-line freedom survives the override: a small request
+    arriving while a large one is mid-flight rides the next step."""
+    cb = make_rb()
+    large = cb.submit(_win(rng, 48))
+    step(cb)
+    small = cb.submit(_win(rng, 2))
+    step(cb)
+    assert small._req.done.is_set()
+    assert not large._req.done.is_set()
+    while not large._req.done.is_set():
+        with cb._cv:
+            k, _ = cb._plan(time.perf_counter() + 1.0)
+            spans = cb._take(k) if k is not None else None
+        assert spans is not None
+        cb._dispatch(spans)
+    assert large.result(0).shape == (48, COLS)
+
+
+def test_ragged_sustained_small_stream_does_not_starve_large(rng):
+    cb = make_rb(max_queue=64)
+    large = cb.submit(_win(rng, 32))
+    for _ in range(12):
+        cb.submit(_win(rng, 2))
+        step(cb)
+        if large._req.done.is_set():
+            break
+    assert large._req.done.is_set()
+
+
 def test_config_validates_batching_policy():
     with pytest.raises(ValueError, match="unknown batching policy"):
         ServeConfig(batching="sometimes")
@@ -385,6 +541,7 @@ def test_config_validates_batching_policy():
     with pytest.raises(ValueError, match="max_queue_age_ms"):
         ServeConfig(max_queue_age_ms=-5.0)
     assert ServeConfig().batching == "continuous"
+    assert ServeConfig(batching="ragged").batching == "ragged"
 
 
 def test_cli_batching_flags_layer_into_config():
@@ -398,6 +555,10 @@ def test_cli_batching_flags_layer_into_config():
     assert cfg.serve.batching == "deadline"
     assert cfg.serve.max_queue_age_ms == 10.0
     assert cfg.serve.rung_upgrade_fill == 0.5
+    ragged = _build_config(
+        build_parser().parse_args(["serve", "ckpt/", "--batching", "ragged"])
+    )
+    assert ragged.serve.batching == "ragged"
     defaults = _build_config(build_parser().parse_args(["serve", "ckpt/"]))
     assert defaults.serve.batching == "continuous"
     assert defaults.serve.max_queue_age_ms == 25.0
@@ -442,6 +603,34 @@ def test_continuous_results_match_solo_predict(session, rng):
         cb.stop()
 
 
+def test_ragged_results_match_solo_predict_zero_recompiles(session, rng):
+    """The ragged acceptance gate on the real device path (interpret-
+    free CPU jit): masked top-rung dispatch is byte-identical to the
+    padded-ladder session.predict for every mixed size, and the whole
+    run adds exactly ONE cache entry (the ragged step itself, compiled
+    once) — the valid count is data, never a shape."""
+    compiled = session.cache_size()
+    cb = RaggedBatcher(session, max_queue_age_ms=5.0)
+    try:
+        xs = [_win(rng, n) for n in (7, 2, 16, 5, 24)]
+        futs = [cb.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_array_equal(f.result(60.0), session.predict(x))
+    finally:
+        cb.stop()
+    assert session.cache_size() == compiled + 1
+    # and a second mixed burst stays at that count (steady state)
+    cb = RaggedBatcher(session, max_queue_age_ms=5.0)
+    try:
+        xs = [_win(rng, n) for n in (1, 13, 16)]
+        futs = [cb.submit(x) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_array_equal(f.result(60.0), session.predict(x))
+    finally:
+        cb.stop()
+    assert session.cache_size() == compiled + 1
+
+
 def _serve_windows(rng, n):
     x = rng.integers(0, C.FEATURE_VOCAB, (n, ROWS, COLS)).astype(np.uint8)
     positions = np.zeros((n, COLS, 2), np.int64)
@@ -469,8 +658,9 @@ def test_http_byte_identity_continuous_vs_deadline_vs_cli(
     session, rng, tmp_path
 ):
     """The ISSUE acceptance gate: for mixed request sizes, continuous-
-    mode replies are byte-identical to deadline-mode replies AND to the
-    batch ``roko-tpu inference`` path on the same windows/params."""
+    mode, deadline-mode, AND ragged-mode replies are byte-identical to
+    each other and to the batch ``roko-tpu inference`` path on the same
+    windows/params."""
     draft = "".join(rng.choice(list("ACGT"), 800))
     cases = {}
     for n in (2, 7, 16, 20):
@@ -484,7 +674,7 @@ def test_http_byte_identity_continuous_vs_deadline_vs_cli(
         )["ctg"]
         cases[n] = (positions, x, expected)
 
-    for mode in ("continuous", "deadline"):
+    for mode in ("continuous", "deadline", "ragged"):
         srv, thread = _spawn_server(
             session, dataclasses.replace(CFG.serve, batching=mode)
         )
@@ -546,11 +736,15 @@ def test_concurrent_http_mixed_traffic(session, rng):
 
 
 @pytest.mark.slow
-def test_fleet_mixed_traffic_zero_client_errors(tmp_path, rng):
+@pytest.mark.parametrize("batching", ["continuous", "ragged"])
+def test_fleet_mixed_traffic_zero_client_errors(tmp_path, rng, batching):
     """ISSUE satellite: mixed small/large traffic against a REAL
-    2-worker fleet running the continuous scheduler — zero client
-    errors, every reply byte-identical to the batch inference path,
-    and the per-worker padding series visible at the front end."""
+    2-worker fleet running the continuous (and, second pass, ragged)
+    scheduler — zero client errors, every reply byte-identical to the
+    batch inference path, and the per-worker padding series visible at
+    the front end. The ragged pass also exercises the loud AOT-bundle
+    skip: workers get a bundle_dir they must decline (ragged steps take
+    (params, x, n); bundles hold padded (params, x) programs)."""
     from roko_tpu.compile import export_bundle
     from roko_tpu.serve.fleet import Fleet
     from roko_tpu.serve.supervisor import make_front_server, worker_command
@@ -560,7 +754,7 @@ def test_fleet_mixed_traffic_zero_client_errors(tmp_path, rng):
         model=TINY,
         mesh=MeshConfig(dp=8),
         serve=ServeConfig(
-            ladder=(8, 16), batching="continuous", max_queue_age_ms=20.0
+            ladder=(8, 16), batching=batching, max_queue_age_ms=20.0
         ),
         fleet=dataclasses.replace(
             RokoConfig().fleet,

@@ -83,8 +83,10 @@ def main() -> int:
             if mesh
             else "?"
         )
+        pallas = model.get("use_pallas")
         print(
             f"bundle: {bundle} kind={kind} "
+            f"pallas={'?' if pallas is None else str(pallas).lower()} "
             f"compute_dtype={model.get('compute_dtype', '?')} "
             f"quantize={model.get('quantize') or 'none'} "
             f"mesh={mesh_s} "
