@@ -230,6 +230,25 @@ def _obs_args(p: argparse.ArgumentParser) -> None:
     )
 
 
+def _store_args(p: argparse.ArgumentParser) -> None:
+    """Object-store data-plane knobs (roko_tpu/datapipe/store.py,
+    docs/STORAGE.md). Retry/hedge/breaker tuning lives in the config
+    file ("store" section) and ROKO_STORE_* env."""
+    p.add_argument(
+        "--store-cache", default=None, metavar="DIR",
+        help="on-disk checksummed block cache for gs:// / s3:// / "
+        "http(s):// reads (sha256-verified entries, identity-pinned, "
+        "LRU-bounded; default: no disk cache). Shareable across "
+        "processes on one host",
+    )
+    p.add_argument(
+        "--store-endpoint", default=None, metavar="URL",
+        help="HTTP(S) gateway prefix gs://bucket/key and s3://bucket/key "
+        "resolve against (e.g. http://127.0.0.1:9000); without it those "
+        "schemes refuse loudly",
+    )
+
+
 def _cascade_args(p: argparse.ArgumentParser) -> None:
     """Adaptive-compute knobs (roko_tpu/cascade, docs/SERVING.md
     "Adaptive compute")."""
@@ -419,18 +438,23 @@ def _build_config(args: argparse.Namespace):
             cascade, enabled=True,
             **({} if casc_flag == -1.0 else {"threshold": casc_flag}),
         )
+    store = over(
+        base.store, cache_dir="store_cache", endpoint="store_endpoint"
+    )
     return RokoConfig(
         window=window, read_filter=read_filter, region=region,
         model=model, train=train, data=data, mesh=mesh, serve=serve,
         fleet=fleet, pipeline=pipeline, distpolish=distpolish,
         resilience=resilience, compile=compile_cfg, guard=guard,
-        cascade=cascade,
+        cascade=cascade, store=store,
     )
 
 
 def cmd_features(args: argparse.Namespace) -> int:
     from roko_tpu.features.pipeline import run_features
 
+    cfg = _build_config(args)
+    _configure_store(cfg)
     n = run_features(
         args.ref,
         args.X,
@@ -438,7 +462,7 @@ def cmd_features(args: argparse.Namespace) -> int:
         bam_y=args.Y,
         workers=args.t,
         seed=args.seed,
-        config=_build_config(args),
+        config=cfg,
         job_retries=args.job_retries,
         job_timeout=args.job_timeout,
     )
@@ -462,10 +486,21 @@ def _configure_event_log(
     print(f"obs: event log -> {path}")
 
 
+def _configure_store(cfg) -> None:
+    """Install the hardened object-store client with this run's config
+    so ``gs://``/``s3://``/``http(s)://`` path arguments resolve through
+    it (--store-cache / --store-endpoint / config "store" section take
+    effect; ROKO_STORE_FAULTS still applies on top)."""
+    from roko_tpu.datapipe.store import configure_store
+
+    configure_store(cfg.store)
+
+
 def cmd_train(args: argparse.Namespace) -> int:
     from roko_tpu.training.loop import train
 
     cfg = _build_config(args)
+    _configure_store(cfg)
     _configure_event_log(cfg.guard.event_log, cfg.guard.event_log_max_mb)
     train(
         cfg, args.train, args.out, val_path=args.val,
@@ -512,6 +547,7 @@ def cmd_inference(args: argparse.Namespace) -> int:
     from roko_tpu.infer import polish_to_fasta
 
     cfg = _build_config(args)
+    _configure_store(cfg)
     params = _load_model_params(args.model, cfg)
     # loader depth comes from --prefetch / PipelineConfig.prefetch; the
     # legacy --t (reference parity: torch DataLoader workers, ref:
@@ -600,6 +636,7 @@ def cmd_polish(args: argparse.Namespace) -> int:
 
     distributed.initialize()  # idempotent; needed for the pod guard
     cfg = _build_config(args)
+    _configure_store(cfg)
     # on a pod every process would otherwise share one JSONL file and
     # race its rotation — same per-process suffix rule as fleet workers
     _configure_event_log(
@@ -884,6 +921,7 @@ def cmd_serve(args: argparse.Namespace) -> int:
     import time
 
     cfg = _build_config(args)
+    _configure_store(cfg)
     _configure_event_log(
         cfg.serve.event_log, cfg.serve.event_log_max_mb,
         worker_id=args.worker_id,
@@ -1187,6 +1225,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _config_arg(p)
     _window_args(p)
+    _store_args(p)
     p.set_defaults(fn=cmd_features)
 
     p = sub.add_parser("train", help="features HDF5 -> checkpoints")
@@ -1236,6 +1275,7 @@ def build_parser() -> argparse.ArgumentParser:
     _data_args(p)
     _guard_args(p)
     _obs_args(p)
+    _store_args(p)
     p.set_defaults(fn=cmd_train)
 
     p = sub.add_parser("inference", help="features HDF5 + checkpoint -> polished FASTA")
@@ -1260,6 +1300,7 @@ def build_parser() -> argparse.ArgumentParser:
     _window_args(p)
     _compile_args(p)
     _cascade_args(p)
+    _store_args(p)
     p.set_defaults(fn=cmd_inference)
 
     p = sub.add_parser("convert", help="torch .pth -> native checkpoint")
@@ -1496,6 +1537,7 @@ def build_parser() -> argparse.ArgumentParser:
     _compile_args(p)
     _cascade_args(p)
     _obs_args(p)
+    _store_args(p)
     p.set_defaults(fn=cmd_polish)
 
     p = sub.add_parser(
@@ -1608,6 +1650,7 @@ def build_parser() -> argparse.ArgumentParser:
     _compile_args(p)
     _cascade_args(p)
     _obs_args(p)
+    _store_args(p)
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser(

@@ -733,6 +733,60 @@ class CascadeConfig:
 
 
 @dataclass(frozen=True)
+class StoreConfig:
+    """Hardened object-store data plane (roko_tpu/datapipe/store.py,
+    docs/STORAGE.md): ranged reads through a checksummed block cache,
+    retry/hedge/breaker around every request, read-verify-commit
+    uploads. ``gs://``/``s3://`` URLs resolve through ``endpoint`` (or
+    ``ROKO_STORE_ENDPOINT``); fault injection is env-only
+    (``ROKO_STORE_FAULTS``)."""
+
+    #: on-disk block/object cache directory (``--store-cache``); None =
+    #: no persistent cache (remote reads are still correct, just colder)
+    cache_dir: Optional[str] = None
+    #: block-cache eviction cap in bytes (LRU past it)
+    cache_bytes: int = 256 * 2**20
+    #: ranged-read granularity — the unit cached and checksummed
+    block_bytes: int = 4 * 2**20
+    #: per-request socket timeout
+    timeout_s: float = 30.0
+    #: total attempts per request (shared RetryPolicy; 1 = no retries)
+    max_attempts: int = 4
+    #: seconds before a straggling ranged read gets a hedged second
+    #: request racing it; 0 disables hedging
+    hedge_s: float = 0.0
+    #: consecutive endpoint failures that trip its circuit breaker
+    breaker_failures: int = 5
+    #: seconds an open breaker waits before half-open probing
+    breaker_reset_s: float = 30.0
+    #: HTTP(S) gateway prefix for gs://-/s3://-scheme URLs
+    endpoint: Optional[str] = None
+
+    def __post_init__(self):
+        if self.cache_bytes < 0:
+            raise ValueError(
+                f"store.cache_bytes must be >= 0, got {self.cache_bytes}"
+            )
+        if self.block_bytes < 1:
+            raise ValueError(
+                f"store.block_bytes must be >= 1, got {self.block_bytes}"
+            )
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"store.max_attempts must be >= 1, got {self.max_attempts}"
+            )
+        if self.hedge_s < 0:
+            raise ValueError(
+                f"store.hedge_s must be >= 0, got {self.hedge_s}"
+            )
+        if self.breaker_failures < 1:
+            raise ValueError(
+                "store.breaker_failures must be >= 1, got "
+                f"{self.breaker_failures}"
+            )
+
+
+@dataclass(frozen=True)
 class RokoConfig:
     window: WindowConfig = field(default_factory=WindowConfig)
     read_filter: ReadFilterConfig = field(default_factory=ReadFilterConfig)
@@ -749,6 +803,7 @@ class RokoConfig:
     compile: CompileConfig = field(default_factory=CompileConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)
     cascade: CascadeConfig = field(default_factory=CascadeConfig)
+    store: StoreConfig = field(default_factory=StoreConfig)
 
     def to_json(self) -> str:
         return json.dumps(_asdict(self), indent=2, sort_keys=True)
@@ -776,6 +831,7 @@ class RokoConfig:
             compile=CompileConfig(**raw.get("compile", {})),
             guard=GuardConfig(**raw.get("guard", {})),
             cascade=CascadeConfig(**raw.get("cascade", {})),
+            store=StoreConfig(**raw.get("store", {})),
         )
 
 

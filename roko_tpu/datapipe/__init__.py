@@ -31,7 +31,14 @@ delegate ``batches(..., skip_batches=)`` to this engine.
 
 from roko_tpu.datapipe.dataset import CheckpointableIterator, ShardedDataset
 from roko_tpu.datapipe.engine import ReadStats, epoch_schedule, iter_span_batches
-from roko_tpu.datapipe.io import open_input, register_opener
+from roko_tpu.datapipe.io import (
+    ensure_local,
+    open_input,
+    open_output,
+    register_opener,
+    register_writer,
+    registered_schemes,
+)
 from roko_tpu.datapipe.manifest import (
     MANIFEST_BASENAME,
     Manifest,
@@ -54,7 +61,11 @@ __all__ = [
     "ManifestMismatch",
     "build_manifest",
     "load_or_build_manifest",
+    "ensure_local",
     "open_input",
+    "open_output",
     "register_opener",
+    "register_writer",
+    "registered_schemes",
     "resolve_file_set",
 ]

@@ -1,5 +1,5 @@
-"""Pluggable input opener behind the datapipe's span reads — the
-ROADMAP item 5(a) seam.
+"""Pluggable input/output seams behind the data plane's reads and
+writes — the ROADMAP item 5(a)/3 seams.
 
 The manifest's span reader historically opened LOCAL paths only
 (``h5py.File(path)``); streaming a corpus from object storage — the
@@ -10,16 +10,20 @@ indirection, deliberately tiny:
 - :func:`open_input` resolves a path to a binary file-like object:
   plain paths and ``file://`` URLs open locally by default; other
   schemes resolve through the opener registry;
-- :func:`register_opener` installs a scheme handler process-wide
-  (``register_opener("gs", fsspec_open)`` is the whole remote-input
-  adapter once an fsspec-like client exists in the image — nothing
-  else in the data plane changes);
+- :func:`open_output` is the matching WRITE seam: local paths open
+  with ``open``; registered remote schemes get an upload-on-close
+  handle (with an ``abort()`` escape hatch so a failed producer never
+  publishes a torn artifact);
+- :func:`register_opener` / :func:`register_writer` install scheme
+  handlers process-wide;
 - :class:`ShardedDataset` accepts a per-dataset ``opener=`` override
   (tests inject a counting ``file://`` shim through it).
 
-No new dependencies: the default opener is ``open``. The container
-image has no fsspec; remote schemes refuse loudly until an adapter is
-registered.
+No new dependencies: the default opener is ``open``, and the
+``gs://`` / ``s3://`` / ``http(s)://`` schemes auto-install the
+stdlib hardened object-store client (``datapipe/store.py``,
+docs/STORAGE.md) on first use. Any other scheme refuses loudly, with
+the currently registered schemes in the message.
 """
 
 from __future__ import annotations
@@ -29,9 +33,15 @@ from typing import BinaryIO, Callable, Dict, Optional
 #: fsspec-style opener signature: ``opener(path, mode) -> file-like``
 Opener = Callable[[str, str], BinaryIO]
 
-#: process-wide scheme registry (``register_opener``); ``file`` and
-#: scheme-less paths never consult it
+#: process-wide scheme registries (``register_opener`` /
+#: ``register_writer``); ``file`` and scheme-less paths never consult
+#: them
 _OPENERS: Dict[str, Opener] = {}
+_WRITERS: Dict[str, Opener] = {}
+
+#: schemes the hardened object-store client (datapipe/store.py) serves;
+#: an unregistered one auto-installs the default client on first use
+_STORE_SCHEMES = ("gs", "s3", "http", "https")
 
 
 def path_scheme(path: str) -> str:
@@ -56,44 +66,136 @@ def local_open(path: str, mode: str = "rb") -> BinaryIO:
     return open(strip_file_scheme(path), mode)
 
 
-def register_opener(scheme: str, opener: Optional[Opener]) -> None:
-    """Install (or with ``None`` remove) the process-wide opener for
-    ``scheme`` — e.g. ``register_opener("gs", ...)`` to stream corpora
-    from object storage. ``file`` / scheme-less paths are not
-    overridable: local reads must stay local."""
+def _check_registrable(scheme: str) -> str:
     scheme = scheme.lower()
     if scheme in ("", "file"):
         raise ValueError(
             "local paths always open through the default opener; "
             f"cannot register scheme {scheme!r}"
         )
+    return scheme
+
+
+def register_opener(scheme: str, opener: Optional[Opener]) -> None:
+    """Install (or with ``None`` remove) the process-wide opener for
+    ``scheme`` — e.g. ``register_opener("gs", ...)`` to stream corpora
+    from object storage. ``file`` / scheme-less paths are not
+    overridable: local reads must stay local."""
+    scheme = _check_registrable(scheme)
     if opener is None:
         _OPENERS.pop(scheme, None)
     else:
         _OPENERS[scheme] = opener
 
 
+def register_writer(scheme: str, writer: Optional[Opener]) -> None:
+    """The :func:`open_output` counterpart of :func:`register_opener`."""
+    scheme = _check_registrable(scheme)
+    if writer is None:
+        _WRITERS.pop(scheme, None)
+    else:
+        _WRITERS[scheme] = writer
+
+
+def registered_schemes() -> Dict[str, tuple]:
+    """``{"input": (...), "output": (...)}`` — the currently registered
+    remote schemes (what the unknown-scheme refusal prints)."""
+    return {
+        "input": tuple(sorted(_OPENERS)),
+        "output": tuple(sorted(_WRITERS)),
+    }
+
+
+def _autoinstall(scheme: str) -> bool:
+    """Lazily install the default hardened store client for its
+    schemes, so a ``gs://``/``http://`` path works with zero setup."""
+    if scheme not in _STORE_SCHEMES:
+        return False
+    from roko_tpu.datapipe import store as _store
+
+    _store.install()
+    return True
+
+
+def _refuse(kind: str, registry: Dict[str, Opener], scheme: str,
+            path: str, register_fn: str) -> ValueError:
+    have = ", ".join(sorted(registry)) or "<none>"
+    return ValueError(
+        f"no {kind} registered for scheme {scheme!r} ({path!r}); "
+        f"currently registered schemes: {have}. Call "
+        f"roko_tpu.datapipe.{register_fn}({scheme!r}, fn) with an "
+        "fsspec-style fn(path, mode) -> file-like"
+    )
+
+
 def open_input(
     path: str, mode: str = "rb", *, opener: Optional[Opener] = None
 ) -> BinaryIO:
     """Open ``path`` for reading through the seam: an explicit
-    ``opener`` wins, then the scheme registry, then the local default.
-    An unregistered remote scheme refuses with the fix in the message
-    instead of a bare ``FileNotFoundError`` on a URL-shaped path."""
+    ``opener`` wins, then the scheme registry (store schemes
+    auto-install), then the local default. An unregistered scheme
+    refuses with the registered-scheme list in the message instead of
+    a bare ``FileNotFoundError`` on a URL-shaped path."""
     if opener is not None:
         return opener(path, mode)
     scheme = path_scheme(path)
     if scheme in ("", "file"):
         return local_open(path, mode)
     handler = _OPENERS.get(scheme)
+    if handler is None and _autoinstall(scheme):
+        handler = _OPENERS.get(scheme)
     if handler is None:
-        raise ValueError(
-            f"no input opener registered for scheme {scheme!r} "
-            f"({path!r}); call roko_tpu.datapipe.register_opener"
-            f"({scheme!r}, opener) with an fsspec-style "
-            "opener(path, mode) -> file-like"
-        )
+        raise _refuse("input opener", _OPENERS, scheme, path,
+                      "register_opener")
     return handler(path, mode)
+
+
+def open_output(
+    path: str, mode: str = "wb", *, writer: Optional[Opener] = None
+) -> BinaryIO:
+    """Open ``path`` for writing through the seam. Local paths open
+    plainly; registered remote schemes return an upload-on-close
+    handle whose ``abort()`` (when present) discards the spooled bytes
+    — error paths must call it instead of publishing a torn object."""
+    if writer is not None:
+        return writer(path, mode)
+    scheme = path_scheme(path)
+    if scheme in ("", "file"):
+        return open(strip_file_scheme(path), mode)
+    handler = _WRITERS.get(scheme)
+    if handler is None and _autoinstall(scheme):
+        handler = _WRITERS.get(scheme)
+    if handler is None:
+        raise _refuse("output writer", _WRITERS, scheme, path,
+                      "register_writer")
+    return handler(path, mode)
+
+
+def abort_output(fh) -> None:
+    """Discard a partially written :func:`open_output` handle: remote
+    handles ``abort()`` (nothing is uploaded); local files just close —
+    the CALLER owns unlinking a torn local file, exactly as before."""
+    abort = getattr(fh, "abort", None)
+    if abort is not None:
+        abort()
+    else:
+        fh.close()
+
+
+def ensure_local(path: str):
+    """A local filesystem path for ``path``: plain/``file://`` paths
+    pass through; store-scheme URLs download (cached, atomic) via
+    ``ObjectStore.localize`` — for consumers that need a REAL filename
+    (the native BAM reader, h5py's mmap fast path)."""
+    scheme = path_scheme(path)
+    if scheme in ("", "file"):
+        return strip_file_scheme(path)
+    from roko_tpu.datapipe import store as _store
+
+    s = _store.install()
+    if path.endswith(".bam"):
+        return s.localize_bam(path)
+    return s.localize(path)
 
 
 def open_h5(path: str, *, opener: Optional[Opener] = None):

@@ -54,11 +54,22 @@ class ManifestMismatch(ManifestError):
     changed files); message carries the per-path diff."""
 
 
+def _is_remote(path: str) -> bool:
+    from roko_tpu.datapipe.io import path_scheme
+
+    return path_scheme(path) not in ("", "file")
+
+
 def resolve_file_set(spec: Union[str, Sequence[str]]) -> List[str]:
     """Resolve a file, directory, or list of paths/globs into the
     canonical file set: lexicographic by basename (stable across hosts
     and filesystems — directory enumeration order is not), symlinked
-    duplicates removed by ``data.hdf5.file_identity``."""
+    duplicates removed by ``data.hdf5.file_identity``.
+
+    A store-scheme URL (``gs://``/``s3://``/``http(s)://``) names ONE
+    corpus file and passes through verbatim — object stores have no
+    portable listing/glob, so a remote corpus is spelled as an explicit
+    URL list; the URL itself is the dedup identity."""
     from roko_tpu.data.hdf5 import file_identity, hdf5_files
 
     specs = [spec] if isinstance(spec, str) else list(spec)
@@ -66,7 +77,9 @@ def resolve_file_set(spec: Union[str, Sequence[str]]) -> List[str]:
         raise ManifestError("empty input file-set spec")
     found: List[str] = []
     for s in specs:
-        if os.path.isdir(s) or os.path.isfile(s):
+        if _is_remote(s):
+            found.append(s)
+        elif os.path.isdir(s) or os.path.isfile(s):
             found.extend(hdf5_files(s))
         else:
             matches = sorted(_glob.glob(s))
@@ -77,7 +90,7 @@ def resolve_file_set(spec: Union[str, Sequence[str]]) -> List[str]:
     out: List[str] = []
     seen: set = set()
     for p in sorted(found, key=lambda p: (os.path.basename(p), p)):
-        ident = file_identity(p)
+        ident = p if _is_remote(p) else file_identity(p)
         if ident in seen:
             continue  # symlinked/duplicate path to the same file
         seen.add(ident)
@@ -87,11 +100,24 @@ def resolve_file_set(spec: Union[str, Sequence[str]]) -> List[str]:
     return out
 
 
+def _file_size(path: str) -> int:
+    """Byte size through the input seam: local files stat; remote ones
+    seek-to-end on a ranged-read handle (no whole-object download)."""
+    if not _is_remote(path):
+        return os.path.getsize(path)
+    from roko_tpu.datapipe.io import open_input
+
+    with open_input(path) as f:
+        return f.seek(0, os.SEEK_END)
+
+
 def _sample_digest(path: str) -> str:
     """sha256 over (size, first/middle/last SAMPLE_BYTES stripes)."""
-    size = os.path.getsize(path)
+    from roko_tpu.datapipe.io import open_input
+
+    size = _file_size(path)
     h = hashlib.sha256(str(size).encode())
-    with open(path, "rb") as f:
+    with open_input(path) as f:
         offsets = {0, max(0, size // 2 - SAMPLE_BYTES // 2), max(0, size - SAMPLE_BYTES)}
         for off in sorted(offsets):
             f.seek(off)
@@ -100,8 +126,10 @@ def _sample_digest(path: str) -> str:
 
 
 def _full_digest(path: str) -> str:
+    from roko_tpu.datapipe.io import open_input
+
     h = hashlib.sha256()
-    with open(path, "rb") as f:
+    with open_input(path) as f:
         for chunk in iter(lambda: f.read(1 << 22), b""):
             h.update(chunk)
     return h.hexdigest()
@@ -221,7 +249,19 @@ class Manifest:
 
     def save(self, path: str) -> None:
         """Atomic write (tmp + fsync + rename), same discipline as the
-        checkpoint integrity manifests."""
+        checkpoint integrity manifests. A remote sidecar goes through
+        ``open_output`` (the store's verified atomic upload)."""
+        if _is_remote(path):
+            from roko_tpu.datapipe.io import abort_output, open_output
+
+            fh = open_output(path, "w")
+            try:
+                json.dump(self.to_dict(), fh, indent=1, sort_keys=True)
+            except BaseException:
+                abort_output(fh)
+                raise
+            fh.close()
+            return
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.to_dict(), f, indent=1, sort_keys=True)
@@ -231,10 +271,16 @@ class Manifest:
 
     @staticmethod
     def load(path: str) -> "Manifest":
+        from roko_tpu.datapipe.io import open_input
+
         try:
-            with open(path) as f:
-                raw = json.load(f)
-        except (OSError, ValueError) as e:
+            with open_input(path) as f:  # binary for local AND remote
+                raw = json.loads(f.read().decode("utf-8"))
+        except (OSError, ValueError, RuntimeError) as e:
+            # RuntimeError: a store-scheme sidecar that 404s/truncates
+            # (datapipe.store.StoreError and subclasses)
+            if isinstance(e, RuntimeError) and not _is_remote(path):
+                raise
             raise ManifestError(f"unreadable manifest {path}: {e}") from None
         if raw.get("version") != MANIFEST_VERSION:
             raise ManifestError(
@@ -262,7 +308,7 @@ class Manifest:
             p = by_name.get(fe.name)
             if p is None:
                 continue
-            size = os.path.getsize(p)
+            size = _file_size(p)
             if size != fe.size:
                 changed.append(f"{fe.name} (size {fe.size} -> {size})")
             elif _sample_digest(p) != fe.sample_sha256:
@@ -282,13 +328,12 @@ class Manifest:
 
 
 def _scan_file(path: str, require_labels: bool) -> Tuple[FileEntry, Dict[str, Any]]:
-    import h5py
-
     from roko_tpu.data.hdf5 import data_group_names
+    from roko_tpu.datapipe.io import open_h5
 
     groups: List[Tuple[str, int]] = []
     geom: Dict[str, Any] = {}
-    with h5py.File(path, "r") as fd:
+    with open_h5(path) as fd:
         for g in data_group_names(fd):
             ex = fd[g]["examples"]
             if require_labels and "labels" not in fd[g]:
@@ -311,7 +356,7 @@ def _scan_file(path: str, require_labels: bool) -> Tuple[FileEntry, Dict[str, An
                 )
     entry = FileEntry(
         name=os.path.basename(path),
-        size=os.path.getsize(path),
+        size=_file_size(path),
         sha256=_full_digest(path),
         sample_sha256=_sample_digest(path),
         groups=tuple(groups),
@@ -377,14 +422,34 @@ def build_manifest(
 
 def default_manifest_path(spec: Union[str, Sequence[str]]) -> Optional[str]:
     """Where the sidecar manifest lives for a simple spec: inside a
-    directory input, next to a single-file input, nowhere (in-memory
-    only) for list/glob specs unless the caller pins a path."""
+    directory input, next to a single-file input (remote single-URL
+    specs included — the sidecar uploads next to the corpus object),
+    nowhere (in-memory only) for list/glob specs unless the caller
+    pins a path."""
     if isinstance(spec, str):
+        if _is_remote(spec):
+            return spec + ".manifest.json"
         if os.path.isdir(spec):
             return os.path.join(spec, MANIFEST_BASENAME)
         if os.path.isfile(spec):
             return spec + ".manifest.json"
     return None
+
+
+def _manifest_exists(mpath: str) -> bool:
+    """``os.path.exists`` generalized through the store: a remote
+    sidecar exists when a ``stat`` succeeds (any store failure —
+    missing object, endpoint down — reads as "no sidecar"; the build
+    path then decides loudly what to do)."""
+    if not _is_remote(mpath):
+        return os.path.exists(mpath)
+    from roko_tpu.datapipe import store as _store
+
+    try:
+        _store.install().stat(mpath)
+        return True
+    except (OSError, RuntimeError, ValueError):
+        return False
 
 
 def load_or_build_manifest(
@@ -406,7 +471,7 @@ def load_or_build_manifest(
     pinned = manifest_path is not None
     mpath = manifest_path or default_manifest_path(spec)
     paths = resolve_file_set(spec)
-    if mpath and os.path.exists(mpath):
+    if mpath and _manifest_exists(mpath):
         try:
             # ManifestError covers unreadable/corrupt/version-mismatch
             # sidecars as well as a file-set mismatch — for the DEFAULT
@@ -434,6 +499,13 @@ def load_or_build_manifest(
         try:
             manifest.save(mpath)
         except OSError as e:  # read-only corpus dir: index stays in RAM
+            if log is not None:
+                log(f"datapipe: could not persist manifest at {mpath}: {e}")
+        except RuntimeError as e:
+            # store upload failure (read-only bucket, endpoint down):
+            # same posture — the index stays in RAM for this run
+            if not _is_remote(mpath):
+                raise
             if log is not None:
                 log(f"datapipe: could not persist manifest at {mpath}: {e}")
     return manifest, paths

@@ -279,7 +279,12 @@ def run_features(
 
 
 def _ensure_bam(path: str, stack) -> str:
-    """Pass BAMs through; convert SAM text to a temp sorted BAM+BAI."""
+    """Pass BAMs through; convert SAM text to a temp sorted BAM+BAI.
+    A store-scheme URL localizes first (cached, atomic, ``.bai``
+    sidecar included) — the native reader needs a real filename."""
+    from roko_tpu.datapipe.io import ensure_local
+
+    path = ensure_local(path)
     with open(path, "rb") as fh:
         magic = fh.read(2)
     if magic == b"\x1f\x8b":  # BGZF (BAM) — use as-is

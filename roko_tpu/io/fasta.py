@@ -4,13 +4,24 @@ roko/features.py:125-126, roko/inference.py:150-154)."""
 from __future__ import annotations
 
 import gzip
+import io
 from typing import Iterator, List, Sequence, Tuple, Union
 
 
 def _open_text(path: str):
+    from roko_tpu.datapipe.io import open_input, path_scheme, strip_file_scheme
+
+    if path_scheme(path) in ("", "file"):
+        # local fast path, unchanged
+        local = strip_file_scheme(path)
+        if local.endswith(".gz"):
+            return gzip.open(local, "rt")
+        return open(local, "r")
+    # remote: ranged/cached binary reads through the opener seam
+    fh = open_input(path)
     if path.endswith(".gz"):
-        return gzip.open(path, "rt")
-    return open(path, "r")
+        return gzip.open(fh, "rt")
+    return io.TextIOWrapper(fh)
 
 
 def iter_fasta(path: str) -> Iterator[Tuple[str, str]]:
@@ -54,6 +65,17 @@ def write_fasta_record(fh, name: str, seq: str, line_width: int = 80) -> None:
 def write_fasta(
     path: str, records: Sequence[Tuple[str, str]], line_width: int = 80
 ) -> None:
-    with open(path, "w") as fh:
+    from roko_tpu.datapipe.io import abort_output, open_output
+
+    fh = open_output(path, "w")
+    try:
         for name, seq in records:
             write_fasta_record(fh, name, seq, line_width)
+    except BaseException:
+        # a remote handle must not upload a half-written FASTA on the
+        # way out; a local file keeps the historical leave-partial
+        # behavior (abort_output just closes it)
+        abort_output(fh)
+        raise
+    else:
+        fh.close()

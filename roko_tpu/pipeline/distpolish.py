@@ -230,9 +230,15 @@ def extract_unit_windows(
     are re-derived from (contig length, config, job seed) exactly as
     the single-process fan-out derives them, so the windows are
     bit-identical to the ones an undistributed run extracts."""
+    from roko_tpu.datapipe.io import ensure_local
     from roko_tpu.features.pipeline import _Job, generate_infer
     from roko_tpu.utils.rng import derive_region_seed
 
+    # store-scheme inputs localize ONCE per worker process (cached,
+    # identity-revalidated) — the native BAM reader and the per-process
+    # ref cache below both want a real filename
+    ref_path = ensure_local(ref_path)
+    bam = ensure_local(bam)
     seq = _cached_refs(ref_path).get(contig)
     if seq is None:
         raise ValueError(f"contig {contig!r} not present in {ref_path}")
@@ -903,11 +909,30 @@ def _run_job_core(
     journal: Optional[PolishJournal] = None
     stack = contextlib.ExitStack()
     try:
-        # SAM text converts ONCE to a temp sorted BAM, exactly as every
-        # other polish path does (features/pipeline.py) — workers on the
-        # shared filesystem read the converted file; shipping the raw
-        # .sam would fail worker-side and masquerade as a poison contig
-        bam_ship = _ensure_bam(bam, stack)
+        from roko_tpu.datapipe.io import open_input, path_scheme
+
+        if path_scheme(bam) not in ("", "file"):
+            # a store-scheme BAM ships as the URL — each worker
+            # localizes it (cached) so the byte stream every unit reads
+            # is store-served, not coordinator-relayed. Must already be
+            # BGZF: a remote SAM would need a conversion temp file no
+            # worker could reach.
+            with open_input(bam) as fh:
+                magic = fh.read(2)
+            if magic != b"\x1f\x8b":
+                raise ValueError(
+                    f"distributed polish needs sorted BAM input; "
+                    f"{bam!r} is not BGZF. Convert the SAM locally and "
+                    "upload the .bam (+ .bai) first."
+                )
+            bam_ship = bam
+        else:
+            # SAM text converts ONCE to a temp sorted BAM, exactly as
+            # every other polish path does (features/pipeline.py) —
+            # workers on the shared filesystem read the converted file;
+            # shipping the raw .sam would fail worker-side and
+            # masquerade as a poison contig
+            bam_ship = _ensure_bam(bam, stack)
         if bam_ship != bam and cfg.serve.data_root is not None:
             # the conversion lands in a tmpdir OUTSIDE the data root,
             # which every worker's path check would 400 — refuse with
@@ -1015,6 +1040,18 @@ def run_distributed_polish(
         fc = dataclasses.replace(fc, workers=2)
     fc = resolve_fleet_topology(fc)
     cfg = dataclasses.replace(cfg, fleet=fc)
+    from roko_tpu.datapipe.io import path_scheme as _scheme
+
+    cache_base = out
+    if _scheme(out) not in ("", "file"):
+        # remote output: the shared window-cache sidecar needs a real
+        # filesystem — key a local scratch dir by the output URL
+        import hashlib as _hashlib
+
+        cache_base = os.path.join(
+            os.path.expanduser("~"), ".cache", "roko_tpu", "journal",
+            _hashlib.sha256(out.encode()).hexdigest()[:16],
+        )
     if cfg.cascade.enabled and not cfg.cascade.cache_dir:
         # shared content-addressed window cache (roko_tpu/cascade,
         # docs/PIPELINE.md): one sidecar beside the output, shared by
@@ -1024,10 +1061,10 @@ def run_distributed_polish(
         cfg = dataclasses.replace(
             cfg,
             cascade=dataclasses.replace(
-                cfg.cascade, cache_dir=out + ".cascade_cache"
+                cfg.cascade, cache_dir=cache_base + ".cascade_cache"
             ),
         )
-        log(f"distpolish: shared cascade cache at {out}.cascade_cache")
+        log(f"distpolish: shared cascade cache at {cache_base}.cascade_cache")
 
     model_identity = {
         "version": BOOT_VERSION,
@@ -1095,12 +1132,24 @@ def make_job_starter(
                 "error": 'body must carry "out" (server-side FASTA '
                          "output path)"
             }
-        if data_root is not None and not path_under_root(out, data_root):
+        from roko_tpu.datapipe.io import path_scheme as _scheme
+        from roko_tpu.datapipe.store import STORE_SCHEMES
+
+        if _scheme(out) in STORE_SCHEMES:
+            if data_root is not None:
+                return 400, {
+                    "error": "field 'out' must lie under the configured "
+                             "data root"
+                }
+            # a store URL passes through verbatim (realpath would
+            # mangle the scheme); the writer uploads on completion
+        elif data_root is not None and not path_under_root(out, data_root):
             return 400, {
                 "error": "field 'out' must lie under the configured "
                          "data root"
             }
-        out = os.path.realpath(out)
+        else:
+            out = os.path.realpath(out)
         try:
             seed = int(payload.get("seed", 0))
         except (TypeError, ValueError):

@@ -94,12 +94,17 @@ class _OrderedFastaWriter:
     order are done, and held in RAM only until then."""
 
     def __init__(self, path: str, order: List[str], line_width: int = 80):
+        from roko_tpu.datapipe.io import open_output
+
         self.path = path
         self._order = list(order)
         self._line_width = line_width
         self._next = 0
         self._ready: Dict[str, str] = {}
-        self._fh = open(path, "w")
+        # local paths open plainly (incremental writes hit disk as
+        # before); a store-scheme path gets an upload-on-close handle —
+        # the object appears atomically once the whole run succeeds
+        self._fh = open_output(path, "w")
 
     def add(self, name: str, seq: str) -> None:
         self._ready[name] = seq
@@ -118,13 +123,21 @@ class _OrderedFastaWriter:
         return self
 
     def __exit__(self, exc_type, *exc) -> None:
-        self._fh.close()
         if exc_type is not None:
             # a failed run must not leave a valid-looking but truncated
             # FASTA behind — the staged path writes the file only after
-            # full success, and resume-style pipelines gate on existence
-            with contextlib.suppress(OSError):
-                os.unlink(self.path)
+            # full success, and resume-style pipelines gate on existence.
+            # A remote handle aborts (nothing is uploaded); a local file
+            # closes and unlinks, exactly as before.
+            abort = getattr(self._fh, "abort", None)
+            if abort is not None:
+                abort()
+            else:
+                self._fh.close()
+                with contextlib.suppress(OSError):
+                    os.unlink(self.path)
+            return
+        self._fh.close()
 
 
 class _RegionProducer:

@@ -64,9 +64,35 @@ def _fsync_write(path: str, data: bytes) -> None:
     os.replace(tmp, path)
 
 
+def _journal_dir(out_path: str) -> str:
+    """Where the journal lives: next to a local output; for a
+    store-scheme output URL (the journal needs a real, fsync-able
+    filesystem) a DETERMINISTIC local scratch dir keyed by the URL —
+    the same host resuming the same remote output finds the same
+    journal."""
+    from roko_tpu.datapipe.io import path_scheme
+
+    if path_scheme(out_path) in ("", "file"):
+        return out_path + ".resume"
+    key = hashlib.sha256(out_path.encode()).hexdigest()[:16]
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "roko_tpu", "journal",
+        key + ".resume",
+    )
+
+
 class PolishJournal:
     def __init__(self, out_path: str):
-        self.dir = out_path + ".resume"
+        from roko_tpu.datapipe.io import path_scheme
+
+        self.dir = _journal_dir(out_path)
+        os.makedirs(os.path.dirname(self.dir) or ".", exist_ok=True)
+        #: remote ``<out>.resume/`` prefix span-pred payloads mirror to
+        #: (through open_output) when the output itself is remote
+        self.remote_dir = (
+            out_path + ".resume"
+            if path_scheme(out_path) not in ("", "file") else None
+        )
         self.meta_path = os.path.join(self.dir, "meta.json")
         self.manifest_path = os.path.join(self.dir, "manifest.jsonl")
         self.units_path = os.path.join(self.dir, "units.jsonl")
@@ -202,6 +228,18 @@ class PolishJournal:
                 os.fsync(fh.fileno())
             os.replace(tmp, path)
             fields["file"] = fname
+            if self.remote_dir is not None:
+                # remote output: the span-pred payload also uploads
+                # (verified PUT through open_output) so the run's
+                # artifacts live with the output object, not only in
+                # this host's scratch
+                from roko_tpu.datapipe.io import open_output
+
+                with open(path, "rb") as src:
+                    data = src.read()
+                dst = open_output(self.remote_dir + "/" + fname, "wb")
+                dst.write(data)
+                dst.close()
         self.unit_event(uid, "commit", durable=True, **fields)
 
     def load_units(self) -> Dict[str, Dict]:

@@ -315,6 +315,12 @@ class ServeMetrics:
                 f"{_PREFIX}cascade_cache_hit_rate "
                 + (f"{ch / cw:.4f}" if cw else "NaN")
             )
+        # object-store client counters (process-wide, not per-worker):
+        # retries/hedges/breaker trips surface here so a faulted remote
+        # data plane is visible without reading logs
+        from roko_tpu.datapipe.store import store_metrics_lines
+
+        lines.extend(store_metrics_lines())
         # mergeable histograms last (fleet-level names, no serve prefix:
         # the supervisor bucket-sums these across workers)
         for hist in (self.hist_latency, self.hist_queue_wait,

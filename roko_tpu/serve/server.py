@@ -248,7 +248,13 @@ def path_under_root(path: str, root: str) -> bool:
 def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
     """Validate a client-named server-local path. ONE error message for
     every failure mode (bad type, outside the root, missing): the reply
-    must not be a file-existence oracle for unauthenticated peers."""
+    must not be a file-existence oracle for unauthenticated peers.
+
+    A store-scheme URL (``gs://``/``s3://``/``http(s)://``) passes
+    through UNLESS a data root is configured — remote inputs localize
+    through the hardened store client (docs/STORAGE.md) and disclose no
+    server-local file, but an operator who confined paths has also
+    confined what this process may fetch."""
     import os
 
     err = _BadRequest(
@@ -257,6 +263,13 @@ def _check_data_path(label: str, path: Any, data_root: Optional[str]) -> str:
     )
     if not isinstance(path, str) or not path:
         raise err
+    from roko_tpu.datapipe.io import path_scheme
+    from roko_tpu.datapipe.store import STORE_SCHEMES
+
+    if path_scheme(path) in STORE_SCHEMES:
+        if data_root is not None:
+            raise err
+        return path
     if data_root is not None and not path_under_root(path, data_root):
         raise err
     real = os.path.realpath(path)

@@ -823,8 +823,11 @@ def test_two_process_sharded_train_deterministic(rng, tmp_path):
 
 def test_open_input_local_file_scheme_and_registry(tmp_path):
     """The fsspec-style seam: plain paths and file:// URLs open locally
-    by default; unknown schemes refuse with the register_opener fix in
-    the message; a registered scheme routes through its adapter."""
+    by default; unknown schemes refuse with the register_opener fix AND
+    the currently-registered scheme list in the message; a registered
+    scheme routes through its adapter. (``gs://`` et al. no longer hit
+    the refusal — they auto-install the store client, whose
+    missing-endpoint refusal is exercised in tests/test_store.py.)"""
     from roko_tpu.datapipe.io import open_input, path_scheme, register_opener
 
     p = tmp_path / "x.bin"
@@ -837,7 +840,9 @@ def test_open_input_local_file_scheme_and_registry(tmp_path):
     with open_input("file://" + str(p)) as fh:  # the file:// shim
         assert fh.read() == b"hello"
     with pytest.raises(ValueError, match="register_opener"):
-        open_input("gs://bucket/key")
+        open_input("artifact://bucket/key")
+    with pytest.raises(ValueError, match="currently registered schemes"):
+        open_input("artifact://bucket/key")
     with pytest.raises(ValueError, match="local paths"):
         register_opener("file", lambda path, mode: open(path, mode))
 
@@ -854,8 +859,6 @@ def test_open_input_local_file_scheme_and_registry(tmp_path):
         assert calls == ["gs://bucket/key"]
     finally:
         register_opener("gs", None)
-    with pytest.raises(ValueError, match="register_opener"):
-        open_input("gs://bucket/key")  # deregistered again
 
 
 def test_sharded_dataset_streams_through_injected_opener(tmp_path, rng):

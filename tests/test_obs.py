@@ -149,7 +149,13 @@ def test_no_bare_roko_event_literals_outside_obs():
                 isinstance(node, ast.Constant)
                 and isinstance(node.value, str)
                 and id(node) not in docstrings
-                and node.value.lstrip().startswith(prefixes)
+                # an event line is the bare prefix or "PREFIX key=..." —
+                # ROKO_STORE_CACHE-style env-var names are not formats
+                and any(
+                    node.value.lstrip() == p
+                    or node.value.lstrip().startswith(p + " ")
+                    for p in prefixes
+                )
             ):
                 offenders.append(f"{rel}:{node.lineno}: {node.value[:60]!r}")
     assert offenders == [], (

@@ -1,0 +1,557 @@
+"""Hardened object-storage data plane (ISSUE 18, docs/STORAGE.md).
+
+The contract under test is RECOVER OR REFUSE LOUDLY: every fault class
+the wire can produce — timeout, 5xx, truncated body, checksum mismatch,
+torn write, breaker-open — either converges to the correct bytes within
+the retry/hedge budget or surfaces a typed StoreError; no reader path
+ever sees silently wrong data. The in-process stub server
+(StubObjectStore) provides scripted faults; FaultyStore provides
+probabilistic ones at the CI storage-gate's default rates.
+"""
+
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import roko_tpu.datapipe.store as st
+from roko_tpu.datapipe import io as dio
+
+
+def _fast_retry(attempts=4):
+    return st.RetryPolicy(
+        max_attempts=attempts, base_delay_s=0.01, max_delay_s=0.05,
+        retryable=(st.StoreError, OSError),
+    )
+
+
+@pytest.fixture(autouse=True)
+def store_state():
+    """Every test gets a clean process-wide store plane: counters
+    zeroed, the default client + scheme registrations restored after."""
+    st.reset_store_counters()
+    saved_default = st._default_store
+    saved_openers = dict(dio._OPENERS)
+    saved_writers = dict(dio._WRITERS)
+    yield
+    with st._default_lock:
+        st._default_store = saved_default
+    dio._OPENERS.clear()
+    dio._OPENERS.update(saved_openers)
+    dio._WRITERS.clear()
+    dio._WRITERS.update(saved_writers)
+    st.reset_store_counters()
+
+
+@pytest.fixture
+def stub(tmp_path):
+    root = tmp_path / "bucket"
+    root.mkdir()
+    srv = st.StubObjectStore(str(root)).start()
+    yield srv, root
+    srv.shutdown()
+    srv.server_close()
+
+
+def _put_local(root, name, data):
+    p = root / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_bytes(data)
+    return data
+
+
+# -- fault-spec parsing ------------------------------------------------------
+
+
+def test_parse_fault_spec():
+    rates = st.parse_fault_spec(
+        "timeout:0.1,http500:0.05,truncate:0.02,torn_write:0.02"
+    )
+    assert rates == {
+        "timeout": 0.1, "http500": 0.05, "truncate": 0.02,
+        "torn_write": 0.02,
+    }
+    with pytest.raises(ValueError, match="kind one of"):
+        st.parse_fault_spec("meteor:0.5")
+    with pytest.raises(ValueError, match="rate"):
+        st.parse_fault_spec("timeout:1.5")
+    with pytest.raises(ValueError, match="fault spec"):
+        st.parse_fault_spec("timeout")
+
+
+# -- block cache -------------------------------------------------------------
+
+
+def test_block_cache_roundtrip_and_corrupt_entry(tmp_path):
+    cache = st.BlockCache(str(tmp_path / "bc"))
+    key = st.BlockCache.key("http://x/a", "id1", 0, 4)
+    assert cache.get(key) is None
+    cache.put(key, b"data")
+    assert cache.get(key) == b"data"
+    # flip payload bytes on disk: the sha256 line no longer matches ->
+    # miss, and the poisoned entry is deleted (not returned, not kept)
+    path = cache._path(key)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[:-2] + b"!!")
+    assert cache.get(key) is None
+    assert not os.path.exists(path)
+    assert st.store_counters()["cache_corrupt"] == 1
+
+
+def test_block_cache_identity_pin_refuses_foreign_dir(tmp_path):
+    d = tmp_path / "bc"
+    st.BlockCache(str(d))
+    with open(d / "meta.json", "w") as fh:
+        json.dump({"kind": "something-else", "version": 9}, fh)
+    with pytest.raises(st.StoreMismatch) as ei:
+        st.BlockCache(str(d))
+    # CascadeMismatch field-diff shape: "key: artifact=X run=Y" lines
+    assert "kind" in str(ei.value) and "something-else" in str(ei.value)
+
+
+def test_block_cache_lru_eviction(tmp_path):
+    cache = st.BlockCache(str(tmp_path / "bc"), max_bytes=3000)
+    keys = [st.BlockCache.key("http://x/a", "id", i * 1000, 1000)
+            for i in range(4)]
+    for i, k in enumerate(keys):
+        cache.put(k, bytes([i]) * 1000)
+        time.sleep(0.01)  # mtime-ordered LRU needs distinct stamps
+    entries, total = cache.stats()
+    assert total <= 3000
+    assert cache.get(keys[0]) is None  # oldest evicted
+    assert cache.get(keys[-1]) == bytes([3]) * 1000
+
+
+# -- scripted fault matrix ---------------------------------------------------
+
+
+def test_transient_5xx_retried_to_success(stub):
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(20000))
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    srv.fail_next(2, status=500)
+    assert store.get_object(srv.url + "/a.bin") == data
+    c = st.store_counters()
+    assert c["retries"] == 2 and c["request_failures"] == 2
+
+
+def test_retry_after_is_a_delay_floor(stub):
+    srv, root = stub
+    _put_local(root, "a.bin", b"x" * 100)
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    srv.fail_next(1, status=503, retry_after=0.5)
+    t0 = time.monotonic()
+    store.get_object(srv.url + "/a.bin")
+    assert time.monotonic() - t0 >= 0.45
+
+
+def test_truncated_ranged_body_retried(stub):
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(30000))
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    srv.truncate_next(1)
+    assert store._ranged_get(srv.url + "/a.bin", 0, 30000) == data
+    assert st.store_counters()["retries"] >= 1
+
+
+def test_checksum_mismatch_on_whole_get_retried(stub):
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(30000))
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    srv.truncate_next(1)  # headers (incl. advertised sha) stay intact
+    assert store.get_object(srv.url + "/a.bin") == data
+    assert st.store_counters()["retries"] >= 1
+
+
+def test_persistent_failure_refuses_loudly(stub):
+    srv, root = stub
+    _put_local(root, "a.bin", b"x")
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry(attempts=3))
+    srv.fail_next(10, status=500)
+    with pytest.raises(st.StoreHTTPError):
+        store.get_object(srv.url + "/a.bin")
+    assert st.store_counters()["retries"] == 2  # 3 attempts total
+
+
+def test_missing_object_is_not_retried(stub):
+    srv, root = stub
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    with pytest.raises(st.StoreHTTPError) as ei:
+        store.get_object(srv.url + "/nope.bin")
+    assert ei.value.status == 404
+    assert st.store_counters()["retries"] == 0  # 4xx = giveup
+
+
+def test_breaker_opens_and_recovers(stub):
+    srv, root = stub
+    data = _put_local(root, "a.bin", b"y" * 50)
+    store = st.ObjectStore(
+        timeout_s=2.0, retry=_fast_retry(attempts=1),
+        breaker_failures=2, breaker_reset_s=0.3,
+    )
+    url = srv.url + "/a.bin"
+    srv.fail_next(2, status=500)
+    for _ in range(2):
+        with pytest.raises(st.StoreHTTPError):
+            store.get_object(url)
+    with pytest.raises(st.BreakerOpen) as ei:
+        store.get_object(url)
+    assert ei.value.retry_after > 0
+    assert st.store_counters()["breaker_open"] >= 1
+    time.sleep(0.35)  # cooldown: HALF_OPEN probe succeeds, breaker closes
+    assert store.get_object(url) == data
+    assert store.get_object(url) == data
+
+
+def test_breaker_open_recovery_within_retry_budget(stub):
+    """BreakerOpen is retryable with the cooldown as the Retry-After
+    floor: one get_object call that arrives while the breaker is open
+    recovers by itself once the endpoint heals."""
+    srv, root = stub
+    data = _put_local(root, "a.bin", b"z" * 50)
+    store = st.ObjectStore(
+        timeout_s=2.0,
+        retry=st.RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                             max_delay_s=0.2,
+                             retryable=(st.StoreError, OSError)),
+        breaker_failures=1, breaker_reset_s=0.2,
+    )
+    srv.fail_next(1, status=500)
+    assert store.get_object(srv.url + "/a.bin") == data
+    assert st.store_counters()["breaker_open"] >= 1
+
+
+def test_hedged_read_beats_straggler(stub):
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(10000))
+    store = st.ObjectStore(timeout_s=10.0, hedge_s=0.15)
+    srv.delay_next(3.0, 1)
+    t0 = time.monotonic()
+    assert store.get_object(srv.url + "/a.bin") == data
+    assert time.monotonic() - t0 < 1.5
+    c = st.store_counters()
+    assert c["hedges"] == 1 and c["hedge_wins"] == 1
+
+
+def test_torn_write_never_becomes_the_object(stub):
+    """FaultyStore's torn_write halves the PUT body while the checksum
+    header stays intact — the stub (like any checksum-verifying
+    gateway) refuses server-side, the client re-PUTs, and a plain
+    reader only ever sees the whole object or none."""
+    srv, root = stub
+    payload = os.urandom(40000)
+    # every PUT torn -> all attempts fail loudly, nothing committed
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    store.transport = st.FaultyStore(
+        store.transport, {"torn_write": 1.0}, seed=1)
+    with pytest.raises(st.StoreError):
+        store.put_object(srv.url + "/t.bin", payload)
+    assert not (root / "t.bin").exists()
+    # tear only the first attempt -> retry commits the full object
+    flaky = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    calls = {"n": 0}
+    real = flaky.transport
+
+    def tear_first(method, url, headers, body, timeout):
+        if method == "PUT" and calls["n"] == 0:
+            calls["n"] += 1
+            body = body[: len(body) // 2]
+        return real(method, url, headers, body, timeout)
+
+    flaky.transport = tear_first
+    flaky.put_object(srv.url + "/t.bin", payload)
+    assert (root / "t.bin").read_bytes() == payload
+    assert st.store_counters()["put_retries"] >= 1
+
+
+# -- reader/writer seams -----------------------------------------------------
+
+
+def test_open_input_unknown_scheme_lists_registered(stub):
+    srv, _ = stub
+    st.install(st.ObjectStore(timeout_s=5.0))
+    with pytest.raises(ValueError) as ei:
+        dio.open_input("warp://bucket/key")
+    msg = str(ei.value)
+    assert "warp" in msg and "currently registered schemes" in msg
+    assert "http" in msg  # the installed store schemes are named
+    with pytest.raises(ValueError, match="currently registered schemes"):
+        dio.open_output("warp://bucket/key")
+
+
+def test_gs_scheme_requires_endpoint(monkeypatch):
+    monkeypatch.delenv("ROKO_STORE_ENDPOINT", raising=False)
+    store = st.ObjectStore(timeout_s=5.0)
+    with pytest.raises(st.StoreError, match="ROKO_STORE_ENDPOINT"):
+        store.stat("gs://bucket/key")
+
+
+def test_gs_resolves_through_endpoint(stub):
+    srv, root = stub
+    data = _put_local(root, "bkt/key.bin", os.urandom(500))
+    store = st.ObjectStore(timeout_s=5.0, endpoint=srv.url)
+    assert store.get_object("gs://bkt/key.bin") == data
+    assert store.get_object("s3://bkt/key.bin") == data
+
+
+def test_store_file_seek_read_and_h5(stub, tmp_path):
+    h5py = pytest.importorskip("h5py")
+    import numpy as np
+
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(100000))
+    store = st.ObjectStore(
+        timeout_s=5.0, cache_dir=str(tmp_path / "bc"),
+        block_bytes=16384,
+    )
+    st.install(store)
+    fh = dio.open_input(srv.url + "/a.bin")
+    assert fh.seek(0, os.SEEK_END) == len(data)
+    fh.seek(12345)
+    assert fh.read(100) == data[12345:12445]
+    fh.seek(-10, os.SEEK_END)
+    assert fh.read() == data[-10:]
+    fh.close()
+    # h5py over ranged HTTP reads through the same handle
+    local = tmp_path / "c.h5"
+    with h5py.File(local, "w") as f:
+        f.create_dataset("x", data=np.arange(1000))
+    _put_local(root, "c.h5", local.read_bytes())
+    with dio.open_h5(srv.url + "/c.h5") as f:
+        np.testing.assert_array_equal(f["x"][:], np.arange(1000))
+    assert st.store_counters()["cache_hits"] > 0
+
+
+def test_fasta_roundtrip_and_abort_through_store(stub):
+    from roko_tpu.io.fasta import iter_fasta, write_fasta
+
+    srv, root = stub
+    st.install(st.ObjectStore(timeout_s=5.0, retry=_fast_retry()))
+    url = srv.url + "/polished.fasta"
+    write_fasta(url, [("ctg1", "ACGT" * 200), ("ctg2", "TTGG" * 50)])
+    back = list(iter_fasta(url))
+    assert back == [("ctg1", "ACGT" * 200), ("ctg2", "TTGG" * 50)]
+
+    def boom():
+        yield ("ctg1", "ACGT")
+        raise RuntimeError("producer died")
+
+    with pytest.raises(RuntimeError, match="producer died"):
+        write_fasta(srv.url + "/torn.fasta", boom())
+    assert not (root / "torn.fasta").exists()  # aborted, never uploaded
+
+
+def test_localize_bam_fetches_bai_sidecar(stub, tmp_path, monkeypatch):
+    from roko_tpu.io.bam import write_sorted_bam
+
+    from .helpers import make_record, cigar_from_string
+
+    srv, root = stub
+    recs = [
+        make_record("r%d" % i, 0, i * 10, "A" * 50,
+                    cigar_from_string("50M"))
+        for i in range(5)
+    ]
+    bam = str(root / "reads.bam")
+    write_sorted_bam(bam, [("ctg1", 2000)], recs)
+    assert os.path.exists(bam + ".bai")
+    scratch = tmp_path / "scratch"
+    store = st.ObjectStore(timeout_s=5.0, cache_dir=str(scratch))
+    st.install(store)
+    local = dio.ensure_local(srv.url + "/reads.bam")
+    assert open(local, "rb").read() == open(bam, "rb").read()
+    assert os.path.exists(local + ".bai")  # sidecar rode along
+    # second localize of an unchanged object: revalidated, same path
+    assert dio.ensure_local(srv.url + "/reads.bam") == local
+
+
+def test_localize_revalidates_identity(stub, tmp_path):
+    srv, root = stub
+    _put_local(root, "a.bin", b"version-one")
+    store = st.ObjectStore(timeout_s=5.0, cache_dir=str(tmp_path / "s"))
+    p1 = store.localize(srv.url + "/a.bin")
+    assert open(p1, "rb").read() == b"version-one"
+    _put_local(root, "a.bin", b"version-TWO!")
+    p2 = store.localize(srv.url + "/a.bin")
+    assert open(p2, "rb").read() == b"version-TWO!"
+
+
+# -- probabilistic fault convergence (the CI gate's default rates) -----------
+
+
+def test_faulty_store_default_rates_converge(stub, tmp_path):
+    """Every reader path, under ROKO_STORE_FAULTS default rates:
+    recover-or-refuse means 30 consecutive operations all return the
+    right bytes (the budget absorbs the faults) with a fixed seed."""
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(60000))
+    store = st.ObjectStore(
+        timeout_s=3.0, cache_dir=str(tmp_path / "bc"),
+        block_bytes=8192,
+        retry=_fast_retry(attempts=6),
+    )
+    store.transport = st.FaultyStore(
+        store.transport,
+        st.parse_fault_spec("timeout:0.1,http500:0.05,truncate:0.02,torn_write:0.02"),
+        seed=1234,
+    )
+    st.install(store)
+    url = srv.url + "/a.bin"
+    for i in range(10):
+        assert store.get_object(url) == data
+    with dio.open_input(url) as fh:
+        fh.seek(30000)
+        assert fh.read(8192) == data[30000:38192]
+    for i in range(5):
+        payload = os.urandom(5000)
+        store.put_object(srv.url + f"/w{i}.bin", payload)
+        assert (root / f"w{i}.bin").read_bytes() == payload
+    assert store.transport.injected  # the wrapper actually fired
+    assert st.store_counters()["faults_injected"] > 0
+
+
+# -- manifest / corpus over the store ----------------------------------------
+
+
+def test_manifest_builds_and_reloads_over_store(stub, tmp_path):
+    h5py = pytest.importorskip("h5py")
+    import numpy as np
+
+    from roko_tpu.datapipe.manifest import load_or_build_manifest
+
+    srv, root = stub
+    local = tmp_path / "corpus.h5"
+    with h5py.File(local, "w") as f:
+        g = f.create_group("contig_1_0")
+        g.create_dataset("examples", data=np.zeros((40, 3, 4), np.uint8))
+        g.create_dataset("labels", data=np.zeros((40, 4), np.int64))
+    _put_local(root, "corpus.h5", local.read_bytes())
+    st.install(st.ObjectStore(timeout_s=5.0, retry=_fast_retry()))
+    url = srv.url + "/corpus.h5"
+    man, paths = load_or_build_manifest(url)
+    assert man.total_rows == 40 and paths == [url]
+    assert (root / "corpus.h5.manifest.json").exists()  # sidecar uploaded
+    man2, _ = load_or_build_manifest(url)  # reload verifies, not rebuild
+    assert man2.fingerprint == man.fingerprint
+
+
+# -- observability -----------------------------------------------------------
+
+
+def test_store_metrics_lines_in_serve_render(stub):
+    srv, root = stub
+    _put_local(root, "a.bin", b"q" * 10)
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    srv.fail_next(1, status=500)
+    store.get_object(srv.url + "/a.bin")
+    lines = st.store_metrics_lines()
+    text = "\n".join(lines)
+    assert "roko_store_requests_total" in text
+    assert "roko_store_retries_total 1" in text
+
+    from roko_tpu.serve.metrics import ServeMetrics
+
+    rendered = ServeMetrics().render()
+    assert "roko_store_requests_total" in rendered
+    assert "roko_store_retries_total 1" in rendered
+
+
+def test_store_events_reach_event_log(stub, tmp_path):
+    from roko_tpu import obs
+
+    srv, root = stub
+    _put_local(root, "a.bin", b"e" * 10)
+    evlog = str(tmp_path / "events.jsonl")
+    obs.configure_event_log(evlog, 4.0)
+    try:
+        store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+        srv.fail_next(1, status=500)
+        store.get_object(srv.url + "/a.bin")
+    finally:
+        obs.configure_event_log(None, 0)
+    recs = [json.loads(l) for l in open(evlog)]
+    retries = [r for r in recs if r.get("event") == "store_retry"]
+    assert retries and retries[0]["subsystem"] == "store"
+    assert retries[0]["url"].endswith("/a.bin")
+
+
+# -- the CI storage-gate (slow lane) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_storage_gate_distpolish_byte_identity_under_faults(tmp_path):
+    """ISSUE 18 acceptance: a real 2-worker ``polish --distributed``
+    whose draft/BAM inputs AND final FASTA live in the (stub) object
+    store, with FaultyStore at the default rates on every process —
+    rc 0, zero client errors, and the downloaded FASTA sha256-identical
+    to a plain ``file://`` run. Store retries/cache hits must be
+    visible in the event logs."""
+    from tests.test_fault_injection import _dist_cmd, _distpolish_project
+
+    proj = _distpolish_project(tmp_path, n_contigs=3, length=2000)
+
+    root = tmp_path / "bucket"
+    root.mkdir()
+    for name, src in (
+        ("draft.fasta", proj["fasta"]),
+        ("reads.bam", proj["bam"]),
+        ("reads.bam.bai", proj["bam"] + ".bai"),
+    ):
+        (root / name).write_bytes(open(src, "rb").read())
+    srv = st.StubObjectStore(str(root)).start()
+    try:
+        remote = dict(
+            proj,
+            fasta=srv.url + "/draft.fasta",
+            bam=srv.url + "/reads.bam",
+        )
+        out_url = srv.url + "/polished.fasta"
+        evlog = str(tmp_path / "events.jsonl")
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            ROKO_STORE_FAULTS=(
+                "timeout:0.1,http500:0.05,truncate:0.02,torn_write:0.02"
+            ),
+            ROKO_STORE_FAULT_SEED="42",
+            ROKO_STORE_CACHE=str(tmp_path / "blockcache"),
+            ROKO_STORE_TIMEOUT_S="10",
+        )
+        res = subprocess.run(
+            _dist_cmd(remote, out_url, evlog),
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert res.returncode == 0, res.stderr[-4000:]
+        polished = root / "polished.fasta"
+        assert polished.exists(), "final FASTA never uploaded"
+        want = hashlib.sha256(
+            open(proj["reference"], "rb").read()).hexdigest()
+        got = hashlib.sha256(polished.read_bytes()).hexdigest()
+        assert got == want, "faulted remote run diverged from file:// run"
+        # the fault plane demonstrably fired and was absorbed: store
+        # events (retry/hedge/cache_hit) in the coordinator+worker logs
+        store_events = []
+        for log in [evlog] + [f"{evlog}.w{i}" for i in range(2)]:
+            if not os.path.exists(log):
+                continue
+            for line in open(log):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if rec.get("subsystem") == "store":
+                    store_events.append(rec["event"])
+        assert store_events, "no store events logged under injected faults"
+        recovery = {"store_retry", "store_hedge", "store_breaker_open"}
+        assert recovery & set(store_events), (
+            "faults were configured but no retry/hedge/breaker event "
+            f"was logged (saw only {sorted(set(store_events))})"
+        )
+    finally:
+        srv.shutdown()
+        srv.server_close()

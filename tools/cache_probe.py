@@ -7,6 +7,7 @@ its digest + rungs + the backend/jax it was built for.
     python tools/cache_probe.py --bundle DIR [...]  # bundle digests too
     python tools/cache_probe.py --registry [DIR]    # model registry too
     python tools/cache_probe.py --window-cache DIR  # cascade sidecar
+    python tools/cache_probe.py --block-cache DIR   # store block cache
 
 Reads only — safe to run next to a live service. Exit 0 always (an
 absent cache is a fact, not a failure). ``ROKO_COMPILE_CACHE`` is
@@ -41,6 +42,12 @@ def main() -> int:
         help="cascade window-cache sidecar dir(s) to summarise "
         "(identity pin from meta.json + entry count + bytes; "
         "docs/SERVING.md 'Adaptive compute'; repeatable)",
+    )
+    ap.add_argument(
+        "--block-cache", action="append", default=[], metavar="DIR",
+        help="object-store block-cache dir(s) to summarise (identity "
+        "pin from meta.json + entry count + bytes; docs/STORAGE.md; "
+        "repeatable)",
     )
     args = ap.parse_args()
 
@@ -134,6 +141,38 @@ def main() -> int:
             + f" threshold={ident.get('threshold', '?')} "
             f"method={ident.get('method', '?')} "
             f"temperature={ident.get('temperature', '?')}"
+        )
+
+    for bdir in args.block_cache:
+        # read-only, same posture as --window-cache: parse the pin and
+        # walk the 2-hex fanout directly rather than opening a
+        # BlockCache (whose pin check refuses a foreign dir — the probe
+        # must never refuse)
+        import json
+
+        meta_path = os.path.join(bdir, "meta.json")
+        try:
+            with open(meta_path) as f:
+                pin = json.load(f)
+        except (OSError, ValueError):
+            print(f"block-cache: {bdir} NO meta.json (not a store block cache?)")
+            continue
+        entries, total = 0, 0
+        for sub in sorted(os.listdir(bdir)):
+            d = os.path.join(bdir, sub)
+            if len(sub) != 2 or not os.path.isdir(d):
+                continue
+            for name in os.listdir(d):
+                if name.endswith(".blk"):
+                    entries += 1
+                    try:
+                        total += os.path.getsize(os.path.join(d, name))
+                    except OSError:
+                        pass
+        print(
+            f"block-cache: {bdir} entries={entries} "
+            f"size={total / 2**20:.1f}MiB "
+            f"kind={pin.get('kind', '?')} version={pin.get('version', '?')}"
         )
 
     if args.registry is not None:
