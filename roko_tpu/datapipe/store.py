@@ -442,6 +442,17 @@ def _parse_retry_after(value: Optional[str]) -> Optional[float]:
         return None  # HTTP-date form: ignore, backoff still applies
 
 
+def _parse_content_length(value: Optional[str]) -> Optional[int]:
+    """A malformed Content-Length is treated as absent, never as a bare
+    ValueError escaping the typed-error contract."""
+    if value is None:
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        return None
+
+
 class ObjectStore:
     """The hardened ranged-read client. Thread-safe; one instance
     serves a whole process (`default_store()`)."""
@@ -553,11 +564,13 @@ class ObjectStore:
                 retry_after=_parse_retry_after(hdrs.get("retry-after")),
             )
         # a body shorter than Content-Length is a transport fault, not
-        # an object property — it counts against the endpoint's breaker
-        want = hdrs.get("content-length")
+        # an object property — it counts against the endpoint's breaker.
+        # An unparsable header is treated as absent (the typed-error
+        # contract: callers only ever see StoreError/OSError).
+        want = _parse_content_length(hdrs.get("content-length"))
         if (
             method != "HEAD" and want is not None
-            and len(data) != int(want)
+            and len(data) != want
         ):
             br.record_failure()
             _bump("request_failures")
@@ -609,7 +622,8 @@ class ObjectStore:
 
         def attempt():
             _, hdrs, _ = self._request("HEAD", url)
-            size = int(hdrs.get("content-length", -1))
+            want = _parse_content_length(hdrs.get("content-length"))
+            size = -1 if want is None else want
             ident = (
                 hdrs.get(CHECKSUM_HEADER)
                 or hdrs.get("etag", "").strip('"')
@@ -651,6 +665,7 @@ class ObjectStore:
             except BaseException as e:  # noqa: BLE001 — reported below
                 results.put((tag, None, e))
 
+        legs = 1
         threading.Thread(
             target=run, args=("primary",), daemon=True
         ).start()
@@ -662,20 +677,23 @@ class ObjectStore:
                 "store", "store_hedge", log=self.log,
                 url=url, after_s=self.hedge_s,
             )
+            legs = 2
             threading.Thread(
                 target=run, args=("hedge",), daemon=True
             ).start()
             tag, value, err = results.get()
-        if err is not None:
-            # one leg failed: wait for the other before giving up
+        if err is not None and legs == 2:
+            # one of two legs failed: wait for the other before giving up
             tag, value, err2 = results.get()
             if err2 is not None:
                 raise err
             err = None
-        if err is None and tag == "hedge":
-            _bump("hedge_wins")
         if err is not None:
+            # sole leg failed (primary failed before the hedge fired):
+            # there is no second result to wait for
             raise err
+        if tag == "hedge":
+            _bump("hedge_wins")
         return value
 
     def read_block(self, url: str, index: int, size: int,
@@ -813,6 +831,13 @@ class ObjectStore:
         is served from disk. Atomic (tmp + rename), so concurrent
         workers localizing the same URL never see a torn file."""
         size, ident = self.stat(url)
+        if size < 0:
+            # same refusal as _StoreRawFile: without a size we would
+            # "download" zero blocks and commit an empty file as verified
+            raise StoreError(
+                f"localize {url!r}: server did not report an object size "
+                "(Content-Length missing on HEAD)"
+            )
         d = os.path.join(
             self._scratch_dir(),
             hashlib.sha256(url.encode()).hexdigest()[:16],
@@ -1088,8 +1113,6 @@ class _StubHandler(BaseHTTPRequestHandler):
 
             _t.sleep(float(fault.get("s", 1.0)))
             return None, data  # sleep then serve normally
-        if kind == "truncate":
-            return None, data[: len(data) // 2]
         status = int(fault.get("status", 500))
         # the faulted reply may leave an unread request body on the
         # socket (PUT): drop the connection so it can't be misparsed
@@ -1114,7 +1137,8 @@ class _StubHandler(BaseHTTPRequestHandler):
         size = len(data)
         sha = hashlib.sha256(data).hexdigest()
         fault = self._scripted_fault()
-        if fault is not None:
+        truncate = fault is not None and fault.get("kind") == "truncate"
+        if fault is not None and not truncate:
             handled, data = self._apply_fault(fault, data)
             if handled is not None:
                 return
@@ -1131,6 +1155,10 @@ class _StubHandler(BaseHTTPRequestHandler):
             body = body[start:end + 1]
             status = 206
             content_range = f"bytes {start}-{end}/{size}"
+        if truncate:
+            # truncate the bytes actually requested — a ranged read must
+            # see the fault too, not just whole-object GETs
+            body = body[: len(body) // 2]
         self.send_response(status)
         self.send_header("Content-Length", str(len(body)))
         self.send_header(CHECKSUM_HEADER, sha)

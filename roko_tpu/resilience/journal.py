@@ -232,14 +232,25 @@ class PolishJournal:
                 # remote output: the span-pred payload also uploads
                 # (verified PUT through open_output) so the run's
                 # artifacts live with the output object, not only in
-                # this host's scratch
+                # this host's scratch. The mirror is supplementary — the
+                # local .npz is what resume reads — so a store failure
+                # here must not fail the unit commit.
                 from roko_tpu.datapipe.io import open_output
+                from roko_tpu.datapipe.store import StoreError
+                from roko_tpu.obs import events as obs_events
 
                 with open(path, "rb") as src:
                     data = src.read()
-                dst = open_output(self.remote_dir + "/" + fname, "wb")
-                dst.write(data)
-                dst.close()
+                try:
+                    dst = open_output(self.remote_dir + "/" + fname, "wb")
+                    dst.write(data)
+                    dst.close()
+                except (StoreError, OSError) as e:
+                    obs_events.emit(
+                        "journal", "unit_mirror_failed",
+                        unit=uid, url=self.remote_dir + "/" + fname,
+                        error=f"{type(e).__name__}: {e}"[:200],
+                    )
         self.unit_event(uid, "commit", durable=True, **fields)
 
     def load_units(self) -> Dict[str, Dict]:

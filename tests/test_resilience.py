@@ -302,6 +302,32 @@ def test_journal_identity_covers_params_and_geometry(tmp_path):
         )
 
 
+def test_journal_unit_commit_survives_mirror_failure(tmp_path, monkeypatch):
+    """The remote span-payload mirror is supplementary: a store failure
+    uploading it must not fail the unit commit — the local .npz plus
+    ledger line are what resume reads."""
+    from roko_tpu.datapipe import io as dio
+    from roko_tpu.datapipe.store import StoreError
+
+    def broken_open_output(path, mode="wb"):
+        raise StoreError(f"store down for {path!r}")
+
+    monkeypatch.setattr(dio, "open_output", broken_open_output)
+    out = str(tmp_path / "p.fasta")
+    j = PolishJournal(out)
+    j.open({"ref": "r", "bam": "b", "seed": 0}, resume=False)
+    j.remote_dir = "http://127.0.0.1:1/p.fasta.resume"
+    j.commit_unit(
+        "u1", 3,
+        positions=np.arange(4, dtype=np.int64),
+        preds=np.arange(4, dtype=np.int64),
+    )
+    j.close()
+    rec = PolishJournal(out).load_units()["u1"]
+    assert rec["state"] == "committed"
+    assert PolishJournal(out).load_unit_preds(rec) is not None
+
+
 # -- streaming-engine integration -------------------------------------------
 
 

@@ -15,6 +15,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import pytest
@@ -236,6 +237,61 @@ def test_hedged_read_beats_straggler(stub):
     assert time.monotonic() - t0 < 1.5
     c = st.store_counters()
     assert c["hedges"] == 1 and c["hedge_wins"] == 1
+
+
+def test_hedged_read_fast_failing_primary_raises_promptly(stub):
+    """Regression: a primary that fails BEFORE hedge_s elapses must
+    raise immediately — there is no second leg to wait for, and waiting
+    for one used to deadlock get_object forever."""
+    srv, root = stub
+    store = st.ObjectStore(timeout_s=5.0, hedge_s=5.0)
+    t0 = time.monotonic()
+    with pytest.raises(st.StoreHTTPError):
+        store.get_object(srv.url + "/missing.bin")  # 404: no retries
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_hedged_failed_leg_waits_for_winning_leg():
+    """When BOTH legs exist, a failed leg defers to the other's
+    success (drive _hedged directly for deterministic ordering)."""
+    store = st.ObjectStore(hedge_s=0.05)
+    lock, calls = threading.Lock(), [0]
+
+    def fn():
+        with lock:
+            calls[0] += 1
+            me = calls[0]
+        if me == 1:
+            time.sleep(0.15)  # past hedge_s, so the hedge leg spawned
+            raise st.StoreError("primary fails after hedge spawned")
+        time.sleep(0.2)  # hedge succeeds AFTER the primary's error
+        return b"ok"
+
+    assert store._hedged("http://x/a", fn) == b"ok"
+    assert st.store_counters()["hedge_wins"] == 1
+
+
+def test_stub_truncate_applies_to_ranged_body(stub):
+    """Regression: the scripted truncate fault must reach a ranged GET
+    whose span lies inside the first half of the object — otherwise the
+    fault-matrix coverage of ranged readers is vacuous."""
+    srv, root = stub
+    data = _put_local(root, "a.bin", os.urandom(30000))
+    store = st.ObjectStore(timeout_s=5.0, retry=_fast_retry())
+    srv.truncate_next(1)
+    assert store._ranged_get(srv.url + "/a.bin", 0, 10000) == data[:10000]
+    assert st.store_counters()["retries"] >= 1
+
+
+def test_localize_refuses_unknown_size(stub, tmp_path):
+    """Regression: a HEAD without Content-Length must refuse, not
+    commit an empty localized file as verified."""
+    srv, root = stub
+    _put_local(root, "a.bin", b"payload")
+    store = st.ObjectStore(timeout_s=5.0, cache_dir=str(tmp_path / "c"))
+    store.stat = lambda url: (-1, "size=-1")
+    with pytest.raises(st.StoreError, match="did not report"):
+        store.localize(srv.url + "/a.bin")
 
 
 def test_torn_write_never_becomes_the_object(stub):
