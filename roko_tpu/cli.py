@@ -382,6 +382,7 @@ def _build_config(args: argparse.Namespace):
         rung_upgrade_fill="rung_upgrade_fill",
         event_log="event_log", event_log_max_mb="event_log_max_mb",
         trace_ring="trace_ring",
+        tenants="tenants",  # already TenantConfig tuple via _tenants_type
     )
     pipeline = over(
         base.pipeline,
@@ -406,7 +407,13 @@ def _build_config(args: argparse.Namespace):
         registry_dir="registry", bake_s="bake_s",
         rollback_error_pct="rollback_error_pct",
         rollback_p99_x="rollback_p99_x",
+        min_workers="min_workers", max_workers="max_workers",
     )
+    ab = getattr(args, "ab_lane", None)
+    if ab is not None:
+        fleet = dataclasses.replace(
+            fleet, ab_version=ab[0], ab_fraction=ab[1]
+        )
     compile_cfg = over(
         base.compile,
         cache_dir="compile_cache", cache_max_mb="cache_max_mb",
@@ -760,6 +767,61 @@ def _workers_type(text: str):
             "worker count must be >= 0 (use 'auto' for device-derived)"
         )
     return n
+
+
+def _tenants_type(text: str):
+    """argparse type for --tenants: a comma list of
+    ``name[:weight[:max_queue[:max_inflight]]]`` specs parsed into
+    :class:`roko_tpu.config.TenantConfig` tuples — a malformed spec is
+    a clean usage error, not a traceback from config validation."""
+    from roko_tpu.config import TenantConfig
+
+    out = []
+    for spec in text.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        parts = spec.split(":")
+        if len(parts) > 4:
+            raise argparse.ArgumentTypeError(
+                f"tenant spec {spec!r}: expected "
+                "name[:weight[:max_queue[:max_inflight]]]"
+            )
+        try:
+            out.append(TenantConfig(
+                name=parts[0],
+                weight=float(parts[1]) if len(parts) > 1 else 1.0,
+                max_queue=int(parts[2]) if len(parts) > 2 else 0,
+                max_inflight=int(parts[3]) if len(parts) > 3 else 0,
+            ))
+        except ValueError as e:
+            raise argparse.ArgumentTypeError(
+                f"tenant spec {spec!r}: {e}"
+            ) from None
+    if not out:
+        raise argparse.ArgumentTypeError("no tenant specs given")
+    return tuple(out)
+
+
+def _ab_lane_type(text: str):
+    """argparse type for --ab-lane: ``VERSION:FRACTION`` with fraction
+    in (0, 1]."""
+    name, sep, frac = text.rpartition(":")
+    if not sep or not name:
+        raise argparse.ArgumentTypeError(
+            f"expected VERSION:FRACTION, got {text!r}"
+        )
+    try:
+        fraction = float(frac)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"A/B fraction {frac!r} is not a number"
+        ) from None
+    if not 0.0 < fraction <= 1.0:
+        raise argparse.ArgumentTypeError(
+            "A/B fraction must be in (0, 1]"
+        )
+    return name, fraction
 
 
 def _ladder_type(text: str):
@@ -1636,6 +1698,36 @@ def build_parser() -> argparse.ArgumentParser:
         "--trace-ring", type=int, default=None,
         help="GET /tracez retention: completed request traces kept in "
         "the last-N ring (default 256; docs/OBSERVABILITY.md)",
+    )
+    p.add_argument(
+        "--tenants", type=_tenants_type, default=None,
+        metavar="NAME[:W[:Q[:I]]],...",
+        help="multi-tenant fair share: comma list of "
+        "name[:weight[:max_queue[:max_inflight]]] specs — requests "
+        "carry X-Roko-Tenant (default tenant otherwise), slots grant "
+        "by deficit-weighted round-robin across tenants, and a tenant "
+        "past its queue/in-flight quota gets 429 + Retry-After "
+        "(docs/SERVING.md 'Multi-tenant & elastic fleet')",
+    )
+    p.add_argument(
+        "--min-workers", type=int, default=None,
+        help="fleet mode: autoscaler floor (default 0 = --workers, "
+        "fixed size); with --max-workers above it the supervisor "
+        "scales worker count on smoothed backlog-per-worker",
+    )
+    p.add_argument(
+        "--max-workers", type=int, default=None,
+        help="fleet mode: autoscaler ceiling (default 0 = --workers, "
+        "fixed size); scale-up is fast on backlog, scale-down waits "
+        "out a sustained idle stretch (hysteresis, no flapping)",
+    )
+    p.add_argument(
+        "--ab-lane", type=_ab_lane_type, default=None,
+        metavar="VERSION:FRACTION",
+        help="fleet mode: route this fraction of UNPINNED traffic to "
+        "workers running the named registered version; per-model "
+        "latency histograms render side by side in /metrics "
+        "(requests may pin model= explicitly either way)",
     )
     # fleet-internal plumbing (the supervisor passes these to its
     # children; automation may use --announce to learn a port-0 bind)

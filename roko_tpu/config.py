@@ -265,6 +265,49 @@ class MeshConfig:
     sp: int = 1
 
 
+#: tenant id every request without an explicit ``X-Roko-Tenant`` header
+#: (or client ``tenant=`` kwarg) is accounted under — unconfigured
+#: single-tenant deployments keep exactly the old behavior because one
+#: tenant's deficit round-robin degenerates to arrival order
+DEFAULT_TENANT = "default"
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One row of the tenant fair-share table (docs/SERVING.md
+    "Multi-tenant & elastic fleet"): admission weight plus optional
+    per-tenant caps. Unlisted tenants get ``weight=1`` and no caps, so
+    the table only needs rows for tenants that differ."""
+
+    name: str
+    #: deficit-round-robin weight: each scheduler round grants a tenant
+    #: ``weight``x the base share of device-slot windows (2.0 = twice
+    #: the bulk tenant's share per round)
+    weight: float = 1.0
+    #: queued windows this tenant may hold in the batcher pool; beyond
+    #: it submissions are rejected 429 + Retry-After (0 = no cap,
+    #: bounded only by the global ``max_queue``)
+    max_queue: int = 0
+    #: concurrent in-flight REQUESTS for this tenant; beyond it 429
+    #: (0 = no cap)
+    max_inflight: int = 0
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        if self.weight <= 0:
+            # zero/negative weight would never accumulate deficit —
+            # the DRR loop's termination proof needs weight > 0
+            raise ValueError(
+                f"tenant {self.name!r} weight must be > 0; got {self.weight}"
+            )
+        if self.max_queue < 0 or self.max_inflight < 0:
+            raise ValueError(
+                f"tenant {self.name!r} caps must be >= 0; got "
+                f"max_queue={self.max_queue} max_inflight={self.max_inflight}"
+            )
+
+
 #: valid ``ServeConfig.batching`` policies: "continuous" packs windows
 #: from many requests densely into ladder-rung device steps and refills
 #: freed capacity the moment earlier requests complete (batch shape
@@ -340,6 +383,10 @@ class ServeConfig:
     #: a slowest-N leaderboard (bounded by construction)
     trace_ring: int = 256
     trace_slowest: int = 32
+    #: tenant fair-share table (``--tenants name:weight:max_queue:
+    #: max_inflight,...``); empty = single default tenant, admission
+    #: behavior byte-identical to the pre-tenant scheduler
+    tenants: Tuple[TenantConfig, ...] = ()
 
     def __post_init__(self) -> None:
         # validate at construction (config layering, JSON load, CLI) so
@@ -371,6 +418,15 @@ class ServeConfig:
                 "trace_ring/trace_slowest must be >= 1; got "
                 f"{self.trace_ring}/{self.trace_slowest}"
             )
+        names = [t.name for t in self.tenants]
+        if len(names) != len(set(names)):
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"duplicate tenant names: {dupes}")
+
+    def tenant_table(self) -> Dict[str, "TenantConfig"]:
+        """``{name: TenantConfig}`` lookup — unlisted tenants fall back
+        to ``TenantConfig(name, weight=1.0)`` at the call site."""
+        return {t.name: t for t in self.tenants}
 
 
 def resolve_ladder(serve: "ServeConfig", dp: int) -> Tuple[int, ...]:
@@ -494,6 +550,67 @@ class FleetConfig:
     #: re-warm) before the rollout gives up and rolls back; generous —
     #: a cold compile on a bundleless config legitimately takes minutes
     rollout_ready_timeout_s: float = 900.0
+    #: backlog-driven autoscaling bounds (docs/SERVING.md "Multi-tenant
+    #: & elastic fleet"): worker count floats in [min_workers,
+    #: max_workers]. Both 0 = autoscaler off (static ``workers`` fleet).
+    #: min_workers 0 with max set defaults the floor to ``workers``.
+    min_workers: int = 0
+    max_workers: int = 0
+    #: autoscaler control-loop cadence in seconds
+    autoscale_interval_s: float = 1.0
+    #: scale UP one worker when the smoothed backlog-per-worker exceeds
+    #: this many windows (and cooldown has passed)
+    autoscale_up_backlog: float = 32.0
+    #: scale DOWN is armed only while smoothed backlog-per-worker stays
+    #: at or below this — deliberately far under the up threshold
+    #: (hysteresis band) so oscillating load cannot flap the fleet
+    autoscale_down_backlog: float = 4.0
+    #: continuous seconds the backlog must stay under the down
+    #: threshold before ONE worker retires (the sustained-idle rule;
+    #: the stretch re-arms after every step down)
+    autoscale_idle_s: float = 10.0
+    #: minimum seconds between scale-up steps (a spike adds workers
+    #: one spawn-latency at a time, not all at once)
+    autoscale_cooldown_s: float = 3.0
+    #: EMA decay for the backlog-per-worker signal (weight on the
+    #: PREVIOUS smoothed value; smaller = twitchier)
+    autoscale_ema_beta: float = 0.5
+    #: A/B candidate lane (``--ab-lane NAME:FRACTION``): registry
+    #: version name a fraction of UNPINNED traffic routes to, with
+    #: per-model latency histograms side by side in /metrics
+    ab_version: Optional[str] = None
+    ab_fraction: float = 0.0
+
+    def __post_init__(self) -> None:
+        if self.min_workers < 0 or self.max_workers < 0:
+            raise ValueError(
+                "min_workers/max_workers must be >= 0; got "
+                f"{self.min_workers}/{self.max_workers}"
+            )
+        if self.max_workers and self.min_workers > self.max_workers:
+            raise ValueError(
+                f"min_workers ({self.min_workers}) exceeds max_workers "
+                f"({self.max_workers})"
+            )
+        if not 0.0 <= self.ab_fraction <= 1.0:
+            raise ValueError(
+                f"ab_fraction must lie in [0, 1]; got {self.ab_fraction}"
+            )
+        if self.ab_fraction > 0 and not self.ab_version:
+            raise ValueError(
+                "ab_fraction > 0 needs ab_version (a registry name)"
+            )
+        if self.autoscale_down_backlog > self.autoscale_up_backlog:
+            raise ValueError(
+                "autoscale_down_backlog must not exceed "
+                "autoscale_up_backlog (the hysteresis band); got "
+                f"{self.autoscale_down_backlog} > {self.autoscale_up_backlog}"
+            )
+        if not 0.0 <= self.autoscale_ema_beta < 1.0:
+            raise ValueError(
+                f"autoscale_ema_beta must lie in [0, 1); got "
+                f"{self.autoscale_ema_beta}"
+            )
 
 
 @dataclass(frozen=True)
@@ -821,7 +938,9 @@ class RokoConfig:
             data=DataConfig(**raw.get("data", {})),
             mesh=MeshConfig(**raw.get("mesh", {})),
             serve=ServeConfig(**{
-                k: tuple(v) if k in ("ladder", "ladder_base") else v
+                k: (tuple(v) if k in ("ladder", "ladder_base")
+                    else tuple(TenantConfig(**t) for t in v)
+                    if k == "tenants" else v)
                 for k, v in raw.get("serve", {}).items()
             }),
             fleet=FleetConfig(**raw.get("fleet", {})),

@@ -71,15 +71,28 @@ class HistogramFamily:
             self._totals[key] = 0
         return row
 
-    def observe(self, seconds: float, label_value: Optional[str] = None) -> None:
+    def observe(
+        self,
+        seconds: float,
+        label_value: Optional[str] = None,
+        extra_labels: Iterable[Tuple[str, str]] = (),
+    ) -> None:
         # linear scan beats bisect at ~18 buckets and costs nothing to
         # reason about; the first bound >= value takes the count
         idx = 0
         for idx, le in enumerate(self.buckets):  # noqa: B007
             if seconds <= le:
                 break
+        # extra_labels adds independent single-label rows (e.g.
+        # tenant="bulk", model="v2") beside the primary-label row; each
+        # key is stored PRE-RENDERED as 'name="value"' so render() can
+        # emit it verbatim and the fleet parse/merge stays label-blind.
+        # The unlabeled aggregate still counts each observation ONCE.
+        keys = [""] + ([label_value] if label_value else []) + [
+            f'{ln}="{lv}"' for ln, lv in extra_labels if lv
+        ]
         with self._lock:
-            for key in ("",) + ((label_value,) if label_value else ()):
+            for key in keys:
                 self._row(key)[idx] += 1
                 self._sums[key] += seconds
                 self._totals[key] += 1
@@ -117,14 +130,21 @@ class HistogramFamily:
         lines = [f"# TYPE {self.name} histogram"]
         for key in ([""] if "" in rows else []) + [k for k in keys if k]:
             counts, total_sum, total = rows[key]
-            extra = f',{self.label}="{key}"' if key and self.label else ""
+            if not key:
+                pair = ""
+            elif '="' in key:
+                # pre-rendered extra-label row (observe(extra_labels=))
+                pair = key
+            else:
+                pair = f'{self.label}="{key}"' if self.label else ""
+            extra = f",{pair}" if pair else ""
             acc = 0
             for le, c in zip(self.buckets, counts):
                 acc += c
                 lines.append(
                     f'{self.name}_bucket{{le="{_fmt_le(le)}"{extra}}} {acc}'
                 )
-            label = f'{{{self.label}="{key}"}}' if key and self.label else ""
+            label = f"{{{pair}}}" if pair else ""
             lines.append(f"{self.name}_sum{label} {total_sum:.6f}")
             lines.append(f"{self.name}_count{label} {total}")
         return lines

@@ -43,7 +43,7 @@ class RequestTrace:
 
     __slots__ = (
         "request_id", "windows", "worker_id", "t_wall", "_t0",
-        "_spans", "_steps", "total_s", "_lock",
+        "_spans", "_steps", "total_s", "_lock", "tenant", "model",
     )
 
     def __init__(
@@ -52,10 +52,16 @@ class RequestTrace:
         *,
         windows: int = 0,
         worker_id: Optional[int] = None,
+        tenant: Optional[str] = None,
+        model: Optional[str] = None,
     ):
         self.request_id = request_id or new_request_id()
         self.windows = windows
         self.worker_id = worker_id
+        #: multi-tenant/model-lane identity (set by the HTTP handler;
+        #: None renders nothing — single-tenant traces are unchanged)
+        self.tenant = tenant
+        self.model = model
         self.t_wall = time.time()
         self._t0 = time.perf_counter()
         #: span name -> [seconds, count]
@@ -121,6 +127,10 @@ class RequestTrace:
         out["ts"] = round(self.t_wall, 3)
         if self.worker_id is not None:
             out["worker_id"] = self.worker_id
+        if self.tenant is not None:
+            out["tenant"] = self.tenant
+        if self.model is not None:
+            out["model"] = self.model
         return out
 
 

@@ -510,6 +510,12 @@ class DistPolishJob:
         in flight) and a draining one parks it, instead of failing."""
         if getattr(self.fleet, "_draining", False):
             return 0
+        if getattr(self.fleet, "jobs_parked", False):
+            # the autoscaler parked background work while interactive
+            # backlog spikes: stop dispatching NEW units (in-flight ones
+            # finish and commit to the journal), resume when unparked —
+            # at most one contig re-runs across the park
+            return 0
         ready = self.fleet.ready_count()
         if ready == 0:
             return 0
@@ -537,7 +543,12 @@ class DistPolishJob:
             while pending or inflight:
                 now = time.monotonic()
                 limit = self._inflight_limit()
-                if limit > 0 or inflight:
+                if (
+                    limit > 0 or inflight
+                    or getattr(self.fleet, "jobs_parked", False)
+                ):
+                    # a PARKED job is waiting by design, not starved —
+                    # the no-ready-worker abort timer must not run
                     no_capacity_since = None
                 elif no_capacity_since is None:
                     no_capacity_since = now

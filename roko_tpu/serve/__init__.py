@@ -35,10 +35,11 @@ Modules:
   with automatic rollback and a crash-consistent journal
 """
 
-from roko_tpu.serve.batcher import Backpressure, MicroBatcher
+from roko_tpu.serve.batcher import Backpressure, MicroBatcher, QuotaExceeded
 from roko_tpu.serve.client import (
     FleetDraining,
     PolishClient,
+    QuotaExceededBusy,
     ServerBusy,
     ServiceUnavailable,
 )
@@ -59,9 +60,14 @@ from roko_tpu.serve.rollout import (
 from roko_tpu.serve.scheduler import ContinuousBatcher, RaggedBatcher
 from roko_tpu.serve.server import drain, make_server, serve_forever
 from roko_tpu.serve.session import PolishSession
-from roko_tpu.serve.supervisor import make_front_server, run_supervisor
+from roko_tpu.serve.supervisor import (
+    Autoscaler,
+    make_front_server,
+    run_supervisor,
+)
 
 __all__ = [
+    "Autoscaler",
     "Backpressure",
     "ContinuousBatcher",
     "Fleet",
@@ -69,6 +75,8 @@ __all__ = [
     "MicroBatcher",
     "PolishClient",
     "PolishSession",
+    "QuotaExceeded",
+    "QuotaExceededBusy",
     "RaggedBatcher",
     "RegistryError",
     "RegistryMismatch",

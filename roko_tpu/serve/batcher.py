@@ -45,10 +45,20 @@ class Backpressure(Exception):
         self.retry_after_s = retry_after_s
 
 
-class _Request:
-    __slots__ = ("x", "done", "preds", "error", "t_submit", "trace")
+class QuotaExceeded(Backpressure):
+    """A TENANT'S quota rejected the request, not global overload: the
+    HTTP layer maps this to 429 + ``Retry-After`` (retrying helps once
+    this tenant's own backlog drains; other tenants are unaffected)."""
 
-    def __init__(self, x: np.ndarray, trace=None):
+    def __init__(self, retry_after_s: float, tenant: str, reason: str):
+        super().__init__(retry_after_s, reason=f"tenant {tenant!r} {reason}")
+        self.tenant = tenant
+
+
+class _Request:
+    __slots__ = ("x", "done", "preds", "error", "t_submit", "trace", "tenant")
+
+    def __init__(self, x: np.ndarray, trace=None, tenant: Optional[str] = None):
         self.x = x
         self.done = threading.Event()
         self.preds: Optional[np.ndarray] = None
@@ -57,6 +67,8 @@ class _Request:
         #: optional per-request obs.trace.RequestTrace riding the
         #: request through the batching plane (docs/OBSERVABILITY.md)
         self.trace = trace
+        #: tenant id for fair-share accounting + latency labels
+        self.tenant = tenant
 
 
 class PredictFuture:
@@ -84,7 +96,9 @@ class PredictFuture:
             # head-of-line-blocking signal continuous batching exists
             # to fix (docs/SERVING.md "Continuous batching")
             self._metrics.observe_request(
-                len(self._req.x), time.perf_counter() - self._req.t_submit
+                len(self._req.x),
+                time.perf_counter() - self._req.t_submit,
+                tenant=getattr(self._req, "tenant", None),
             )
         return self._req.preds
 
@@ -167,14 +181,19 @@ class MicroBatcher:
 
     # -- client side --------------------------------------------------------
 
-    def submit(self, x: np.ndarray, trace=None) -> PredictFuture:
+    def submit(
+        self, x: np.ndarray, trace=None, tenant: Optional[str] = None
+    ) -> PredictFuture:
         """Enqueue one window batch; raises :class:`Backpressure` when
         the queue is full and ``RuntimeError`` once the batcher has been
         stopped (a dead worker must fail requests fast, not strand
         their futures). ``trace`` (a
         :class:`roko_tpu.obs.trace.RequestTrace`) collects the
         queue-wait / device span breakdown for the reply's ``timings``
-        field."""
+        field. ``tenant`` labels the request's latency row; the
+        deadline coalescer has no tenant fair-share (whole requests
+        dispatch FIFO — use continuous/ragged mode for DRR admission),
+        so here it is accounting only."""
         if self._stopped:
             raise RuntimeError("batcher stopped")
         if self.breaker is not None and not self.breaker.allow():
@@ -187,7 +206,7 @@ class MicroBatcher:
                 max(self.breaker.retry_after_s(), self.retry_after_s),
                 reason="circuit breaker open (device failing)",
             )
-        req = _Request(np.ascontiguousarray(x, dtype=np.uint8), trace)
+        req = _Request(np.ascontiguousarray(x, dtype=np.uint8), trace, tenant)
         try:
             self._q.put_nowait(req)
         except queue.Full:

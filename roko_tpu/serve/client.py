@@ -34,6 +34,25 @@ class ServerBusy(RuntimeError):
         self.retry_after_s = retry_after_s
 
 
+class QuotaExceededBusy(ServerBusy):
+    """429 from the service: THIS TENANT's quota (queue or in-flight
+    cap) is exhausted, not the global queue — other tenants are still
+    being served. Retryable like :class:`ServerBusy` (the Retry-After
+    is sized from the tenant's own backlog and drain rate), typed so
+    callers can distinguish their own quota from fleet-wide
+    pressure."""
+
+    def __init__(self, retry_after_s: float, tenant: Optional[str] = None):
+        RuntimeError.__init__(
+            self,
+            f"tenant quota exceeded"
+            + (f" ({tenant})" if tenant else "")
+            + f"; retry after {retry_after_s:.1f}s",
+        )
+        self.retry_after_s = retry_after_s
+        self.tenant = tenant
+
+
 class FleetDraining(ServerBusy):
     """503 with a DRAINING status: the fleet (or worker) is
     deliberately shedding all new work for a rollout/shutdown window —
@@ -127,6 +146,22 @@ class PolishClient:
                     # instead of retrying into the drain
                     raise FleetDraining(retry) from None
                 raise ServerBusy(retry) from None
+            if e.code == 429:
+                # per-tenant quota breach: the Retry-After header (or
+                # body field) carries the tenant-sized wait
+                try:
+                    parsed = json.loads(body)
+                    retry = float(parsed.get("retry_after_s", 1.0))
+                    tenant = parsed.get("tenant")
+                except (ValueError, TypeError, UnicodeDecodeError):
+                    retry, tenant = 1.0, None
+                try:
+                    retry = max(
+                        retry, float(e.headers.get("Retry-After", 0))
+                    )
+                except (TypeError, ValueError):
+                    pass
+                raise QuotaExceededBusy(retry, tenant) from None
             try:
                 detail = json.loads(body).get("error", "")
             except ValueError:
@@ -151,24 +186,27 @@ class PolishClient:
     def _post_with_retries(
         self, payload: Dict[str, Any], retries: int,
         request_id: Optional[str] = None,
+        extra_headers: Optional[Dict[str, str]] = None,
     ) -> Dict[str, Any]:
         """POST /polish, sleeping through up to ``retries``
         :class:`ServerBusy` replies (503: queue full, breaker open, or
-        draining) with the policy's backoff floored by the server's
-        ``Retry-After`` — never failing on the first backpressure
-        response unless asked to (``retries=0``). Exhausting the budget
-        raises the typed :class:`ServiceUnavailable` (a ServerBusy
-        subclass) carrying the attempt count."""
+        draining; 429: tenant quota) with the policy's backoff floored
+        by the server's ``Retry-After`` — never failing on the first
+        backpressure response unless asked to (``retries=0``).
+        Exhausting the budget raises the typed
+        :class:`ServiceUnavailable` (a ServerBusy subclass) carrying
+        the attempt count."""
         import dataclasses
 
         policy = dataclasses.replace(
             self.retry_policy, max_attempts=retries + 1
         )
         # the 2-arg call stays the default so _request stand-ins (tests)
-        # keep working; the header rides only when an id is pinned
-        headers = (
-            {"X-Roko-Request-Id": request_id} if request_id else None
-        )
+        # keep working; headers ride only when something is pinned
+        headers = dict(extra_headers or {})
+        if request_id:
+            headers["X-Roko-Request-Id"] = request_id
+        headers = headers or None
         try:
             return json.loads(
                 policy.call(
@@ -197,13 +235,22 @@ class PolishClient:
         contig: str = "seq",
         retries: int = 4,
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        model: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Polish one contig from pre-extracted windows. ``retries``
         bounds how many :class:`ServerBusy` replies are slept through
         (honouring the server's retry-after as a backoff floor) before
         giving up; 0 surfaces the first busy reply. ``request_id`` pins
         the trace identity (``X-Roko-Request-Id``) — by default the
-        service mints one and returns it in the reply."""
+        service mints one and returns it in the reply.
+
+        ``tenant`` names the fair-share/quota bucket this request bills
+        to (``X-Roko-Tenant``; the default tenant otherwise). ``model``
+        PINS a registered model version (``X-Roko-Model``): the fleet
+        verifies it against the registry and routes to workers running
+        it, refusing loudly (RegistryMismatch, HTTP 400) rather than
+        silently serving the incumbent."""
         examples = np.asarray(examples)
         payload = {
             "contig": contig,
@@ -212,7 +259,16 @@ class PolishClient:
             "positions": _b64(positions, np.int64),
             "examples": _b64(examples, np.uint8),
         }
-        return self._post_with_retries(payload, retries, request_id)
+        headers: Dict[str, str] = {}
+        if tenant is not None:
+            payload["tenant"] = tenant
+            headers["X-Roko-Tenant"] = tenant
+        if model is not None:
+            payload["model"] = model
+            headers["X-Roko-Model"] = model
+        return self._post_with_retries(
+            payload, retries, request_id, headers or None
+        )
 
     def polish_bam(
         self, ref: str, bam: str, workers: int = 1, seed: int = 0,

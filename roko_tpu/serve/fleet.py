@@ -58,7 +58,12 @@ from roko_tpu.config import FleetConfig, RokoConfig
 from roko_tpu.obs import events as obs_events
 from roko_tpu.obs.hist import merge_histogram_rows, parse_histogram_rows, render_histogram_rows
 from roko_tpu.resilience import CircuitBreaker, RetryPolicy
-from roko_tpu.serve.metrics import HISTOGRAM_SERIES, parse_metric_values
+from roko_tpu.serve.metrics import (
+    HISTOGRAM_SERIES,
+    LABELED_SERIES,
+    parse_labeled_rows,
+    parse_metric_values,
+)
 
 # worker lifecycle states (rendered in /healthz and the
 # roko_fleet_worker_state gauge)
@@ -187,6 +192,12 @@ class WorkerHandle:
         #: PR 10 live backlog/throughput estimate); None until it
         #: answers a probe
         self.retry_hint: Optional[float] = None
+        #: live queue depth (windows) from the last answered /healthz —
+        #: the autoscaler's backlog signal
+        self.queue_windows: Optional[int] = None
+        #: per-tenant {"backlog_windows", "retry_after_s"} hints from
+        #: the last answered /healthz (multi-tenant 429/503 sizing)
+        self.tenant_hints: Dict[str, Dict[str, float]] = {}
         #: restart-storm breaker: record_failure per death, record_success
         #: once stable; OPEN = stop restarting (fleet degrades), half-open
         #: after storm_reset_s admits exactly one probe restart
@@ -277,8 +288,17 @@ class Fleet:
         )
         self._lock = threading.RLock()
         self._rr = 0
+        #: ids of workers still drain-terminating off the routing path —
+        #: scale-up must not re-mint such an id while its announce file
+        #: and device slice may still be live
+        self._retiring: set = set()
+        #: autoscaler parks background distpolish jobs while interactive
+        #: backlog spikes; DistPolishJob reads it via _inflight_limit
+        #: (journal checkpoints make park/resume ≤ 1 contig re-run)
+        self.jobs_parked = False
         self._counters = {"restarts": 0, "failovers": 0,
-                          "requests": 0, "rejected": 0}
+                          "requests": 0, "rejected": 0,
+                          "scale_ups": 0, "scale_downs": 0}
         self._stop = threading.Event()
         self._draining = False
         self._drain_done = threading.Event()
@@ -378,6 +398,9 @@ class Fleet:
         env = dict(os.environ)
         env.update(spec.env(w.id))
         env["ROKO_WORKER_ID"] = str(w.id)
+        # the worker's model-lane identity: labels its latency
+        # histograms and arms the X-Roko-Model pin guard server-side
+        env["ROKO_MODEL_VERSION"] = spec.version
         # append: across restarts one log per worker slot keeps the
         # whole crash history in a single CI-dumpable file
         logf = open(w.log_path, "ab", buffering=0)
@@ -402,6 +425,8 @@ class Fleet:
         # a dead incarnation's backlog estimate must not inflate
         # front-end 503s (live_retry_after_s takes the fleet MAX)
         w.retry_hint = None
+        w.queue_windows = None
+        w.tenant_hints = {}
 
     def roll_worker(self, w: WorkerHandle, version: str) -> None:
         """Deliberate restart of ONE worker onto ``version`` (the
@@ -436,6 +461,100 @@ class Fleet:
         finally:
             with self._lock:
                 w.hold = False
+
+    # -- elastic sizing -----------------------------------------------------
+
+    def scale_to(self, n: int, *, reason: str = "") -> int:
+        """Resize the fleet to ``n`` workers (the autoscaler's actuator;
+        docs/SERVING.md "Multi-tenant & elastic fleet").
+
+        Scale-UP appends fresh :class:`WorkerHandle`\\ s on the LOWEST
+        free ids (ids double as device-slice indices, so they stay
+        dense; a retiring worker's id is not free until its drain
+        completes) targeting the active version, spawned through the
+        same launch-spec resolution as boot/restart/rollout. Scale-DOWN
+        retires the highest-id non-held workers: each leaves
+        ``self.workers`` immediately (routing and supervision stop
+        seeing it) and drains in a background thread — SIGTERM lets it
+        finish in-flight requests under the drain deadline, so clients
+        never observe the shrink. Refused (no-op) while the fleet is
+        draining. Returns the new worker count."""
+        added: List[WorkerHandle] = []
+        victims: List[WorkerHandle] = []
+        with self._lock:
+            if self._draining:
+                return len(self.workers)
+            n = max(1, int(n))
+            cur = len(self.workers)
+            if n == cur:
+                return cur
+            if n > cur:
+                # lowest free id: ids double as device-slice indices
+                # (fleet_worker_env), so they must stay dense within
+                # [0, max_workers) — a retiring worker's id is NOT free
+                # until its drain completes (announce file + slice)
+                used = {w.id for w in self.workers} | self._retiring
+                for _ in range(n - cur):
+                    wid = 0
+                    while wid in used:
+                        wid += 1
+                    used.add(wid)
+                    w = WorkerHandle(wid, self.runtime_dir, self.fleet_cfg)
+                    w.version = w.target_version = self.active_version
+                    self.workers.append(w)
+                    added.append(w)
+                self.inc("scale_ups")
+            else:
+                pool = sorted(
+                    (w for w in self.workers if not w.hold),
+                    key=lambda w: w.id,
+                )
+                while len(self.workers) - len(victims) > n and pool:
+                    v = pool.pop()  # highest id first: LIFO shrink
+                    victims.append(v)
+                for v in victims:
+                    self.workers.remove(v)
+                    self._retiring.add(v.id)
+                    if v.state == READY:
+                        v.state = DRAINING
+                self.inc("scale_downs")
+            if self.fleet_cfg.max_inflight == 0:
+                # derived admission cap tracks the live worker count
+                self.max_inflight = (
+                    len(self.workers) * self.cfg.serve.max_queue
+                )
+        now = self._clock()
+        for w in added:
+            try:
+                self._spawn(w, now)
+            except OSError as e:
+                self._note_death(w, now, f"spawn failed: {e}")
+        for v in victims:
+            threading.Thread(
+                target=self._retire, args=(v,),
+                name=f"roko-fleet-retire-{v.id}", daemon=True,
+            ).start()
+        self._log(
+            f"roko fleet: scaled {cur} -> {len(self.workers)} workers"
+            + (f" ({reason})" if reason else "")
+        )
+        return len(self.workers)
+
+    def _retire(self, w: WorkerHandle) -> None:
+        """Drain-terminate one retired worker off the routing path."""
+        grace = (
+            self.cfg.resilience.drain_deadline_s
+            + self.fleet_cfg.term_grace_s
+        )
+        self._terminate(w, grace)
+        w.state = STOPPED
+        w.port = None
+        try:
+            os.unlink(w.announce_path)
+        except OSError:
+            pass
+        with self._lock:
+            self._retiring.discard(w.id)
 
     def stop(
         self, *, rolling: bool = True, cleanup: bool = True
@@ -508,7 +627,8 @@ class Fleet:
     def tick(self) -> None:
         """One supervision pass over every worker (public so tests can
         drive supervision synchronously with a fake clock)."""
-        for w in self.workers:
+        # snapshot: scale_to() mutates self.workers concurrently
+        for w in list(self.workers):
             if self._draining:
                 return
             self._check(w, self._clock())
@@ -577,6 +697,15 @@ class Fleet:
             # (PR 10) rides in healthz; cache it so front-end 503s can
             # promise a real wait instead of the static config guess
             w.retry_hint = float(hint)
+        qw = body.get("queue_windows")
+        if isinstance(qw, (int, float)):
+            w.queue_windows = int(qw)
+        th = body.get("tenants")
+        if isinstance(th, dict):
+            # per-tenant backlog/Retry-After hints for 429/503 sizing
+            w.tenant_hints = {
+                str(t): h for t, h in th.items() if isinstance(h, dict)
+            }
         status = body.get("status", "")
         if code == 200:
             if w.state != READY:
@@ -642,23 +771,52 @@ class Fleet:
     # -- routing ------------------------------------------------------------
 
     def ready_count(self) -> int:
-        return sum(1 for w in self.workers if w.state == READY)
+        return sum(1 for w in list(self.workers) if w.state == READY)
 
-    def live_retry_after_s(self) -> float:
-        """Retry-After for front-end 503s (draining, at capacity, no
+    def live_retry_after_s(self, tenant: Optional[str] = None) -> float:
+        """Retry-After for front-end 503/429s (draining, at capacity, no
         worker available): the LARGEST hint any live worker reported in
         its last answered /healthz — each worker computes its own from
         live backlog over observed throughput (docs/SERVING.md
         "Continuous batching") — falling back to the static
         ``serve.retry_after_s`` only when no worker has answered (none
-        bound yet, or all dead)."""
+        bound yet, or all dead).
+
+        With ``tenant`` given, the hint is sized from THAT tenant's
+        backlog and observed drain rate (the workers' per-tenant
+        healthz hints), not the global queue — a quota-limited bulk
+        tenant must not inflate the wait promised to an interactive
+        one."""
         with self._lock:
-            hints = [
-                w.retry_hint
-                for w in self.workers
-                if w.retry_hint is not None and w.alive()
-            ]
+            workers = list(self.workers)
+        if tenant is not None:
+            t_hints = []
+            for w in workers:
+                if not w.alive():
+                    continue
+                h = w.tenant_hints.get(tenant)
+                ra = h.get("retry_after_s") if h else None
+                if isinstance(ra, (int, float)) and ra > 0:
+                    t_hints.append(float(ra))
+            if t_hints:
+                return max(t_hints)
+        hints = [
+            w.retry_hint
+            for w in workers
+            if w.retry_hint is not None and w.alive()
+        ]
         return max(hints) if hints else self.cfg.serve.retry_after_s
+
+    def backlog_windows(self) -> int:
+        """Total queued windows across live workers (last answered
+        /healthz) — the autoscaler's raw backlog signal."""
+        with self._lock:
+            workers = list(self.workers)
+        return sum(
+            w.queue_windows
+            for w in workers
+            if w.queue_windows is not None and w.alive()
+        )
 
     def suspect(self, w: WorkerHandle) -> None:
         """A worker that dropped a connection leaves rotation NOW; the
@@ -671,19 +829,21 @@ class Fleet:
                 w.state = UNHEALTHY
 
     def pick(
-        self, exclude: Sequence[int] = ()
+        self, exclude: Sequence[int] = (), version: Optional[str] = None
     ) -> Optional[Tuple[WorkerHandle, int]]:
         """Round-robin over in-rotation workers, skipping ``exclude``
-        (ids already tried for this request). Returns the handle AND a
-        port snapshot taken under the lock: the supervision thread
-        nulls ``w.port`` when a worker dies, and reading it later would
-        race — ``HTTPConnection(host, None)`` silently falls back to
-        port 80."""
+        (ids already tried for this request); ``version`` restricts to
+        workers running that model version (per-request model lanes).
+        Returns the handle AND a port snapshot taken under the lock:
+        the supervision thread nulls ``w.port`` when a worker dies, and
+        reading it later would race — ``HTTPConnection(host, None)``
+        silently falls back to port 80."""
         with self._lock:
             ready = [
                 w for w in self.workers
                 if w.state == READY and w.id not in exclude
                 and w.port is not None
+                and (version is None or w.version == version)
             ]
             if not ready:
                 return None
@@ -696,6 +856,9 @@ class Fleet:
         body: bytes,
         timeout: Optional[float] = None,
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        model_version: Optional[str] = None,
+        pinned: bool = False,
     ) -> Tuple[int, bytes, Dict[str, str]]:
         """Route one ``POST /polish`` body to a ready worker with
         transparent failover: a connection-level failure (worker died
@@ -704,6 +867,16 @@ class Fleet:
         Worker 503s try the next worker, then surface as a fleet 503
         with the largest ``Retry-After`` observed. Returns
         ``(status, reply_body, extra_headers)``.
+
+        ``tenant`` rides every dispatch as ``X-Roko-Tenant`` so worker
+        fair-share/quota accounting sees the tenant without the front
+        end re-serializing the body. ``model_version`` restricts
+        routing to workers running that version: ``pinned=True`` (the
+        client named it) also forwards ``X-Roko-Model`` for the
+        worker-side identity guard and surfaces a loud 503 when no
+        ready worker runs it; ``pinned=False`` (A/B lane assignment)
+        falls back softly to any ready worker when the candidate lane
+        is empty — lane routing is an optimization, never an outage.
 
         ``request_id`` (assigned by the front end) rides every dispatch
         as ``X-Roko-Request-Id`` — including the failover RE-dispatch,
@@ -716,7 +889,11 @@ class Fleet:
         # under the lock, which the hot 200 path must never pay
         retry_after: Optional[float] = None
         for _ in range(max(1, cfg.failover_attempts)):
-            picked = self.pick(exclude=tried)
+            picked = self.pick(exclude=tried, version=model_version)
+            if picked is None and model_version is not None and not pinned:
+                # lane soft-fallback: candidate lane empty or busy —
+                # the incumbent serves the request
+                picked = self.pick(exclude=tried)
             if picked is None:
                 break
             w, port = picked
@@ -732,7 +909,9 @@ class Fleet:
                 )
             try:
                 code, reply, hdrs = self._forward(
-                    port, body, timeout, request_id=request_id
+                    port, body, timeout, request_id=request_id,
+                    tenant=tenant,
+                    model_version=model_version if pinned else None,
                 )
             except _CONN_ERRORS as e:
                 # the worker vanished mid-request: suspect it (the
@@ -753,7 +932,7 @@ class Fleet:
                 continue
             if code == 503:
                 if retry_after is None:
-                    retry_after = self.live_retry_after_s()
+                    retry_after = self.live_retry_after_s(tenant)
                 try:
                     retry_after = max(
                         retry_after, float(hdrs.get("Retry-After", 0))
@@ -761,11 +940,26 @@ class Fleet:
                 except ValueError:
                     pass
                 continue
+            if code == 429:
+                # tenant quota breach: the worker's Retry-After promise
+                # must reach the client intact
+                keep = {
+                    k: v for k, v in hdrs.items()
+                    if k.lower() == "retry-after"
+                }
+                return code, reply, keep
             return code, reply, {}
         if retry_after is None:
-            retry_after = self.live_retry_after_s()
+            retry_after = self.live_retry_after_s(tenant)
+        if pinned and model_version is not None and not tried:
+            msg = (
+                f"no ready worker runs model {model_version!r} "
+                "(fleet busy, rolling, or lane not deployed)"
+            )
+        else:
+            msg = "no worker available (fleet busy or degraded)"
         body_out = json.dumps({
-            "error": "no worker available (fleet busy or degraded)",
+            "error": msg,
             "retry_after_s": retry_after,
         }).encode()
         return 503, body_out, {"Retry-After": f"{max(1, round(retry_after))}"}
@@ -776,6 +970,8 @@ class Fleet:
         body: bytes,
         timeout: Optional[float] = None,
         request_id: Optional[str] = None,
+        tenant: Optional[str] = None,
+        model_version: Optional[str] = None,
     ) -> Tuple[int, bytes, Dict[str, str]]:
         """One POST /polish to one worker's snapshotted port, no
         retries here. The default read timeout is generous (a polish
@@ -791,6 +987,10 @@ class Fleet:
         headers = {"Content-Type": "application/json"}
         if request_id is not None:
             headers["X-Roko-Request-Id"] = request_id
+        if tenant is not None:
+            headers["X-Roko-Tenant"] = tenant
+        if model_version is not None:
+            headers["X-Roko-Model"] = model_version
         try:
             conn.request("POST", "/polish", body=body, headers=headers)
             resp = conn.getresponse()
@@ -822,6 +1022,7 @@ class Fleet:
     def summary(self) -> Dict[str, object]:
         """The supervisor ``/healthz`` body: aggregate status + the
         per-worker state map."""
+        workers = list(self.workers)
         up = self.ready_count()
         states = {
             str(w.id): {
@@ -830,17 +1031,17 @@ class Fleet:
                 "restarts": w.restarts,
                 "version": w.version,
             }
-            for w in self.workers
+            for w in workers
         }
         if self._draining:
             status, code = "draining", 503
-        elif up == len(self.workers):
+        elif up == len(workers):
             status, code = "ok", 200
         elif up >= 1:
             # serving on the survivors: a load balancer may still route
             # here, but the degradation is visible
             status, code = "degraded", 200
-        elif any(w.state in (WARMING, STARTING) for w in self.workers):
+        elif any(w.state in (WARMING, STARTING) for w in workers):
             status, code = "warming", 503
         else:
             status, code = "unhealthy", 503
@@ -857,31 +1058,36 @@ class Fleet:
         selected per-worker series re-labeled by worker id (scraped
         live from each bound worker with the heartbeat timeout;
         unanswering workers are simply absent from the passthrough)."""
+        workers = list(self.workers)
         p = "roko_fleet_"
         lines = [
             f"# TYPE {p}workers gauge",
-            f"{p}workers {len(self.workers)}",
+            f"{p}workers {len(workers)}",
             f"# TYPE {p}workers_up gauge",
             f"{p}workers_up {self.ready_count()}",
         ]
-        for name in ("restarts", "failovers", "requests", "rejected"):
+        for name in ("restarts", "failovers", "requests", "rejected",
+                     "scale_ups", "scale_downs"):
             lines.append(f"# TYPE {p}{name}_total counter")
             lines.append(f"{p}{name}_total {self.counter(name)}")
+        lines.append(f"# TYPE {p}jobs_parked gauge")
+        lines.append(f"{p}jobs_parked {1 if self.jobs_parked else 0}")
         lines.append(f"# TYPE {p}worker_state gauge")
-        for w in self.workers:
+        for w in workers:
             lines.append(
                 f'{p}worker_state{{worker="{w.id}"}} '
                 f"{STATE_CODES.get(w.state, 9)}"
             )
         lines.append(f"# TYPE {p}worker_restarts_total counter")
-        for w in self.workers:
+        for w in workers:
             lines.append(
                 f'{p}worker_restarts_total{{worker="{w.id}"}} {w.restarts}'
             )
         # info-style: which model version each worker runs (the mixed-
-        # fleet window during a rollout is visible from one scrape)
+        # fleet window during a rollout or an A/B lane is visible from
+        # one scrape)
         lines.append(f"# TYPE {p}model_version gauge")
-        for w in self.workers:
+        for w in workers:
             lines.append(
                 f'{p}model_version{{worker="{w.id}",'
                 f'version="{w.version}"}} 1'
@@ -894,7 +1100,7 @@ class Fleet:
         names = tuple(n for n, _ in PASSTHROUGH_SERIES)
         scraped: Dict[int, Dict[str, str]] = {}
         bodies: Dict[int, str] = {}
-        for w in self.workers:
+        for w in workers:
             if w.port is None or not w.alive():
                 continue
             try:
@@ -917,6 +1123,25 @@ class Fleet:
             lines.append(f"# TYPE {name} {kind}")
             for wid, val in rows:
                 lines.append(f'{name}{{worker="{wid}"}} {val}')
+        # tenant-/model-labeled worker series, re-exported with the
+        # worker id appended inside the braces — fleet dashboards see
+        # per-tenant admission and per-model traffic per worker
+        labeled = {
+            wid: parse_labeled_rows(body, LABELED_SERIES)
+            for wid, body in sorted(bodies.items())
+        }
+        for name in LABELED_SERIES:
+            rows2 = [
+                (wid, lbody, val)
+                for wid, per in labeled.items()
+                for lbody, val in per.get(name, [])
+            ]
+            if not rows2:
+                continue
+            kind = "counter" if name.endswith("_total") else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            for wid, lbody, val in rows2:
+                lines.append(f'{name}{{{lbody},worker="{wid}"}} {val}')
         # MERGEABLE histograms (docs/OBSERVABILITY.md): fleet-level rows
         # are the bucket-wise SUM of the worker rows — sound because
         # every process shares DEFAULT_LATENCY_BUCKETS — so a fleet p99
@@ -945,7 +1170,7 @@ class Fleet:
         not answering is simply absent)."""
         out: Dict[str, object] = {}
         path = "/tracez" + (f"?{query}" if query else "")
-        for w in self.workers:
+        for w in list(self.workers):
             if w.port is None or not w.alive():
                 continue
             try:

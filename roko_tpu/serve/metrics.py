@@ -74,6 +74,38 @@ HISTOGRAM_SERIES = (
 )
 
 
+#: labeled series the fleet supervisor passes through row-by-row
+#: (tenant/model dimensions — serve/fleet.py re-exports each row with a
+#: ``worker="i"`` label appended inside the existing braces)
+LABELED_SERIES = (
+    "roko_serve_tenant_requests_total",
+    "roko_serve_tenant_rejected_total",
+    "roko_serve_tenant_backlog",
+    "roko_serve_model_requests_total",
+)
+
+
+def parse_labeled_rows(text: str, names) -> Dict[str, list]:
+    """Extract ``{name: [(label_body, value), ...]}`` for LABELED
+    series in a Prometheus text body (``name{labels} value`` lines;
+    ``label_body`` is the raw text inside the braces). The companion of
+    :func:`parse_metric_values` for the tenant-/model-labeled rows the
+    fleet re-exports per worker."""
+    wanted = set(names)
+    out: Dict[str, list] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        if name not in wanted or "}" not in rest:
+            continue
+        body, _, value = rest.partition("}")
+        value = value.strip()
+        if value:
+            out.setdefault(name, []).append((body, value))
+    return out
+
+
 def parse_metric_values(text: str, names) -> Dict[str, str]:
     """Extract ``{name: value}`` for unlabeled series in a Prometheus
     text body — the fleet supervisor scrapes each worker's ``/metrics``
@@ -116,6 +148,18 @@ class ServeMetrics:
         #: deadline mode, the series are simply absent)
         self.queue_windows: Optional[Callable[[], int]] = None
         self.occupancy: Optional[Callable[[], float]] = None
+        #: per-tenant queued-window gauge source (set by
+        #: ContinuousBatcher; None = no tenant backlog series)
+        self.tenant_backlogs: Optional[Callable[[], Dict[str, int]]] = None
+        #: per-tenant request/rejection counters (tenant-labeled rows)
+        self._tenant_requests: Dict[str, int] = {}
+        self._tenant_rejected: Dict[str, int] = {}
+        #: per-model request counter; ``model_version`` is this worker's
+        #: own registry version identity (env ROKO_MODEL_VERSION, set by
+        #: the fleet spawn path) — it labels the latency histogram so
+        #: A/B lanes compare fleet-merged per-model rows
+        self._model_requests: Dict[str, int] = {}
+        self.model_version: Optional[str] = None
         #: mergeable cumulative histograms (fixed shared buckets, so the
         #: fleet supervisor can SUM worker rows — docs/OBSERVABILITY.md):
         #: request latency by size class, plus the queue-wait /
@@ -145,18 +189,47 @@ class ServeMetrics:
                 return f"le{rung}"
         return f"gt{self.size_classes[-1]}"
 
-    def observe_request(self, windows: int, seconds: float) -> None:
+    def observe_request(
+        self,
+        windows: int,
+        seconds: float,
+        tenant: Optional[str] = None,
+        model: Optional[str] = None,
+    ) -> None:
         """One completed request: the aggregate latency span plus its
         size-class span (PredictFuture.result calls this for both
         batching modes, so the per-class p50/p99 comparison is
-        apples-to-apples)."""
+        apples-to-apples). ``tenant`` and the worker's own
+        ``model_version`` become extra single-label histogram rows, so
+        per-tenant and per-model latency merge fleet-wide exactly like
+        the size-class rows do."""
         self.timer.record("request", seconds)
         label = self.size_class(windows) if self.size_classes else None
         if label is not None:
             self.timer.record(f"request:{label}", seconds)
+        model = model or self.model_version
+        extra = []
+        if tenant:
+            extra.append(("tenant", tenant))
+            with self._lock:
+                self._tenant_requests[tenant] = (
+                    self._tenant_requests.get(tenant, 0) + 1
+                )
+        if model:
+            extra.append(("model", model))
+            with self._lock:
+                self._model_requests[model] = (
+                    self._model_requests.get(model, 0) + 1
+                )
         # the histogram sees every request the summary sees, so a
         # bucket-derived fleet p99 is consistent with per-worker data
-        self.hist_latency.observe(seconds, label)
+        self.hist_latency.observe(seconds, label, extra_labels=extra)
+
+    def inc_tenant_rejected(self, tenant: str) -> None:
+        with self._lock:
+            self._tenant_rejected[tenant] = (
+                self._tenant_rejected.get(tenant, 0) + 1
+            )
 
     def observe_cascade(
         self,
@@ -239,6 +312,41 @@ class ServeMetrics:
             lines.append(
                 f"{_PREFIX}scheduler_occupancy {self.occupancy():.4f}"
             )
+        # tenant/model dimensions (labeled rows; absent until traffic
+        # carries a tenant id or the worker has a version identity)
+        with self._lock:
+            t_req = dict(self._tenant_requests)
+            t_rej = dict(self._tenant_rejected)
+            m_req = dict(self._model_requests)
+        t_backlog = self.tenant_backlogs() if self.tenant_backlogs else {}
+        if t_req:
+            lines.append(f"# TYPE {_PREFIX}tenant_requests_total counter")
+            for t in sorted(t_req):
+                lines.append(
+                    f'{_PREFIX}tenant_requests_total{{tenant="{t}"}} '
+                    f"{t_req[t]}"
+                )
+        if t_rej:
+            lines.append(f"# TYPE {_PREFIX}tenant_rejected_total counter")
+            for t in sorted(t_rej):
+                lines.append(
+                    f'{_PREFIX}tenant_rejected_total{{tenant="{t}"}} '
+                    f"{t_rej[t]}"
+                )
+        if t_backlog:
+            lines.append(f"# TYPE {_PREFIX}tenant_backlog gauge")
+            for t in sorted(t_backlog):
+                lines.append(
+                    f'{_PREFIX}tenant_backlog{{tenant="{t}"}} '
+                    f"{int(t_backlog[t])}"
+                )
+        if m_req:
+            lines.append(f"# TYPE {_PREFIX}model_requests_total counter")
+            for m in sorted(m_req):
+                lines.append(
+                    f'{_PREFIX}model_requests_total{{model="{m}"}} '
+                    f"{m_req[m]}"
+                )
         lines.append(f"# TYPE {_PREFIX}cpu_fallback gauge")
         lines.append(f"{_PREFIX}cpu_fallback {int(bool(self.cpu_fallback()))}")
         if self.breaker is not None:

@@ -62,11 +62,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from roko_tpu.config import DEFAULT_TENANT, TenantConfig
 from roko_tpu.resilience import CircuitBreaker
 from roko_tpu.serve.batcher import (
     _REQUEST_ERRORS,
     Backpressure,
     PredictFuture,
+    QuotaExceeded,
 )
 from roko_tpu.serve.metrics import ServeMetrics
 from roko_tpu.serve.session import PolishSession
@@ -91,10 +93,10 @@ class _Slot:
 
     __slots__ = (
         "x", "preds", "next", "filled", "done", "error", "t_submit",
-        "trace",
+        "trace", "tenant",
     )
 
-    def __init__(self, x: np.ndarray, trace=None):
+    def __init__(self, x: np.ndarray, trace=None, tenant: str = DEFAULT_TENANT):
         self.x = x
         self.preds = np.empty((x.shape[0], x.shape[2]), np.int32)
         self.next = 0       # windows handed to a device step so far
@@ -105,6 +107,8 @@ class _Slot:
         #: optional per-request obs.trace.RequestTrace (queue-wait /
         #: pack / device-step / scatter spans — docs/OBSERVABILITY.md)
         self.trace = trace
+        #: tenant id for deficit-round-robin slot granting + quotas
+        self.tenant = tenant
 
     @property
     def n(self) -> int:
@@ -137,6 +141,7 @@ class ContinuousBatcher:
         retry_after_s: Optional[float] = None,
         metrics: Optional[ServeMetrics] = None,
         breaker: Optional[CircuitBreaker] = None,
+        tenants: Optional[Tuple[TenantConfig, ...]] = None,
         start: bool = True,
     ):
         serve_cfg = session.cfg.serve
@@ -185,10 +190,22 @@ class ContinuousBatcher:
             session, "_window_shape", (w.window_rows, w.window_cols)
         )
         self._ema_wps: Optional[float] = None
+        #: tenant fair-share state (docs/SERVING.md "Multi-tenant &
+        #: elastic fleet"): the config table (unlisted tenants default
+        #: to weight 1, no caps), the DRR credit counters the slot-grant
+        #: loop spends, and a per-tenant drain-rate EMA feeding the
+        #: per-tenant Retry-After hint
+        table = serve_cfg.tenants if tenants is None else tenants
+        self._tenant_cfg: Dict[str, TenantConfig] = {
+            t.name: t for t in table
+        }
+        self._deficit: Dict[str, float] = {}
+        self._tenant_wps: Dict[str, float] = {}
         if metrics is not None:
             metrics.queue_depth = lambda: len(self._pool)
             metrics.queue_windows = self.backlog_windows
             metrics.occupancy = self.occupancy
+            metrics.tenant_backlogs = self.tenant_backlogs
         if start:
             self.start()
 
@@ -206,6 +223,20 @@ class ContinuousBatcher:
         step is already oversubscribed)."""
         return self.backlog_windows() / self.session.ladder[-1]
 
+    def tenant_backlogs(self) -> Dict[str, int]:
+        """Queued-not-yet-packed windows per tenant — the healthz
+        ``tenants`` block and the ``roko_serve_tenant_backlog`` gauge
+        (the fleet derives per-tenant Retry-After from these)."""
+        out: Dict[str, int] = {}
+        with self._cv:
+            for s in self._pool:
+                out[s.tenant] = out.get(s.tenant, 0) + (s.n - s.next)
+        return out
+
+    def _tenant_weight(self, tenant: str) -> float:
+        cfg = self._tenant_cfg.get(tenant)
+        return cfg.weight if cfg is not None else 1.0
+
     def snapshot(self) -> Dict[str, Any]:
         """The live scheduler state ``GET /tracez`` serves beside the
         trace ring (docs/OBSERVABILITY.md): queued-window backlog,
@@ -218,6 +249,32 @@ class ContinuousBatcher:
             history = list(self._rung_history)
             ema = self._ema_wps
             steps = self._steps
+            tenant_backlog: Dict[str, int] = {}
+            tenant_inflight: Dict[str, int] = {}
+            for s in self._pool:
+                tenant_backlog[s.tenant] = (
+                    tenant_backlog.get(s.tenant, 0) + (s.n - s.next)
+                )
+            for s in self._live.values():
+                tenant_inflight[s.tenant] = (
+                    tenant_inflight.get(s.tenant, 0) + 1
+                )
+            tenants = {
+                t: {
+                    "backlog_windows": tenant_backlog.get(t, 0),
+                    "inflight": tenant_inflight.get(t, 0),
+                    "deficit": round(self._deficit.get(t, 0.0), 4),
+                    "weight": self._tenant_weight(t),
+                    "ema_windows_per_s": (
+                        round(self._tenant_wps[t], 2)
+                        if t in self._tenant_wps else None
+                    ),
+                }
+                for t in sorted(
+                    set(tenant_backlog) | set(tenant_inflight)
+                    | set(self._deficit)
+                )
+            }
         return {
             "mode": self.BATCHING_MODE,
             "backlog_windows": backlog,
@@ -237,6 +294,7 @@ class ContinuousBatcher:
                 }
                 for s in live
             ],
+            "tenants": tenants,
             "rung_history": history,
         }
 
@@ -255,6 +313,29 @@ class ContinuousBatcher:
             return self.base_retry_after_s
         # +1 top rung: even an empty queue waits out the step in flight
         est = (backlog + self.session.ladder[-1]) / wps
+        return min(_RETRY_AFTER_MAX_S, max(_RETRY_AFTER_MIN_S, est))
+
+    def tenant_retry_after_s(self, tenant: Optional[str] = None) -> float:
+        """Retry-After from ONE tenant's backlog and ITS observed drain
+        rate (ISSUE satellite): an interactive tenant rejected while a
+        bulk tenant holds the global queue is told its own short wait,
+        not the bulk tenant's. Falls back to the global hint when the
+        tenant has no drain history yet."""
+        if not tenant:
+            return self.retry_after_s
+        with self._cv:
+            backlog = sum(
+                s.n - s.next for s in self._pool if s.tenant == tenant
+            )
+            wps = self._tenant_wps.get(tenant)
+            active = {s.tenant for s in self._pool} | {tenant}
+        if not wps or wps <= 0:
+            return self.retry_after_s
+        # the tenant's fair slice of the step in flight stands in for
+        # the global hint's +1 top rung
+        wsum = sum(self._tenant_weight(t) for t in active)
+        slice_ = self.session.ladder[-1] * self._tenant_weight(tenant) / wsum
+        est = (backlog + slice_) / wps
         return min(_RETRY_AFTER_MAX_S, max(_RETRY_AFTER_MIN_S, est))
 
     # -- lifecycle -----------------------------------------------------------
@@ -304,14 +385,17 @@ class ContinuousBatcher:
 
     # -- client side ---------------------------------------------------------
 
-    def submit(self, x: np.ndarray, trace=None) -> PredictFuture:
+    def submit(
+        self, x: np.ndarray, trace=None, tenant: Optional[str] = None
+    ) -> PredictFuture:
         """Admit one window batch into the slot pool; raises
         :class:`Backpressure` (with the computed Retry-After) when the
-        pool is at capacity and ``ValueError`` on bad window geometry —
-        validated HERE so a malformed request can never poison the
-        shared device step it would have been packed into (the deadline
-        batcher fails a whole coalesced batch on one bad member; dense
-        packing must not)."""
+        pool is at capacity, :class:`QuotaExceeded` (mapped to 429)
+        when the TENANT's own queue/inflight cap is hit, and
+        ``ValueError`` on bad window geometry — validated HERE so a
+        malformed request can never poison the shared device step it
+        would have been packed into (the deadline batcher fails a whole
+        coalesced batch on one bad member; dense packing must not)."""
         if self._stopped:
             raise RuntimeError("batcher stopped")
         x = np.ascontiguousarray(x, dtype=np.uint8)
@@ -320,7 +404,8 @@ class ContinuousBatcher:
                 f"windows shaped {x.shape}, want (n,) + "
                 f"{self._window_shape}"
             )
-        slot = _Slot(x, trace)
+        tenant = tenant or DEFAULT_TENANT
+        slot = _Slot(x, trace, tenant)
         if slot.n == 0:
             # nothing to schedule: complete immediately (the empty reply
             # is still well-formed). Decided BEFORE the breaker check —
@@ -340,6 +425,34 @@ class ContinuousBatcher:
         with self._cv:
             if self._stopped:
                 raise RuntimeError("batcher stopped")
+            tcfg = self._tenant_cfg.get(tenant)
+            if tcfg is not None and (tcfg.max_queue or tcfg.max_inflight):
+                queued = sum(
+                    s.n - s.next for s in self._pool if s.tenant == tenant
+                )
+                inflight = sum(
+                    1 for s in self._live.values() if s.tenant == tenant
+                )
+                over = (
+                    tcfg.max_queue and queued + slot.n > tcfg.max_queue
+                ) or (tcfg.max_inflight and inflight >= tcfg.max_inflight)
+                if over:
+                    # the TENANT's quota said no, not global overload:
+                    # 429 with the tenant's own drain estimate (other
+                    # tenants' backlogs never inflate this hint)
+                    if self.breaker is not None:
+                        self.breaker.cancel_probe()
+                    if self.metrics is not None:
+                        self.metrics.inc("rejected")
+                        self.metrics.inc_tenant_rejected(tenant)
+                    raise QuotaExceeded(
+                        self.tenant_retry_after_s(tenant),
+                        tenant,
+                        "queue quota exceeded"
+                        if tcfg.max_queue
+                        and queued + slot.n > tcfg.max_queue
+                        else "inflight quota exceeded",
+                    )
             if len(self._pool) >= self.max_queue:
                 if self.breaker is not None:
                     # a half-open allow() claimed the probe slot for a
@@ -394,43 +507,94 @@ class ContinuousBatcher:
 
     def _take(self, k: int) -> List[Span]:
         """Pack ``k`` window slots from the pool under the lock —
-        fair-share over requests in arrival order (repeated rounds of
-        ~k/active each until the slots are spent), adjacent spans of
-        one request merged. Exhausted requests leave the pool; they
-        complete when their scattered predictions arrive."""
+        deficit-weighted round-robin over TENANTS (each round splits the
+        remaining slots by tenant weight into credit; a tenant spends
+        whole-window credit, fractions carry to the next round), and
+        fair-share over each tenant's requests in arrival order inside
+        its grant. Adjacent spans of one request merge. With a single
+        tenant the credit split is the full remainder and the loop
+        reduces exactly to the old per-request fair share. Exhausted
+        requests leave the pool; they complete when their scattered
+        predictions arrive. Tenants whose backlog drains forfeit
+        residual credit — an idle tenant never hoards a burst."""
         spans: List[Span] = []
         off = 0
         now = time.perf_counter()
+
+        def pack(slot: _Slot, take: int) -> None:
+            nonlocal off
+            if slot.next == 0:
+                # first window of this request packs now: the
+                # queue-wait span ends here (mergeable histogram +
+                # the request's own trace)
+                wait = now - slot.t_submit
+                if slot.trace is not None:
+                    slot.trace.add("queue_wait", wait)
+                if self.metrics is not None:
+                    self.metrics.hist_queue_wait.observe(wait)
+            if spans and spans[-1][0] is slot and (
+                spans[-1][1] + spans[-1][2] == slot.next
+            ):
+                prev = spans[-1]
+                spans[-1] = (slot, prev[1], prev[2] + take, prev[3])
+            else:
+                spans.append((slot, slot.next, take, off))
+            slot.next += take
+            off += take
+
         while off < k:
-            live = [s for s in self._pool if s.next < s.n]
-            if not live:
-                break
-            share = max(1, (k - off) // len(live))
-            for slot in live:
-                take = min(share, slot.n - slot.next, k - off)
-                if take <= 0:
+            # group pending requests by tenant, both levels in arrival
+            # order (first-seen tenant order is itself arrival order)
+            order: List[str] = []
+            by_tenant: Dict[str, List[_Slot]] = {}
+            for s in self._pool:
+                if s.next >= s.n:
                     continue
-                if slot.next == 0:
-                    # first window of this request packs now: the
-                    # queue-wait span ends here (mergeable histogram +
-                    # the request's own trace)
-                    wait = now - slot.t_submit
-                    if slot.trace is not None:
-                        slot.trace.add("queue_wait", wait)
-                    if self.metrics is not None:
-                        self.metrics.hist_queue_wait.observe(wait)
-                if spans and spans[-1][0] is slot and (
-                    spans[-1][1] + spans[-1][2] == slot.next
-                ):
-                    prev = spans[-1]
-                    spans[-1] = (slot, prev[1], prev[2] + take, prev[3])
-                else:
-                    spans.append((slot, slot.next, take, off))
-                slot.next += take
-                off += take
+                if s.tenant not in by_tenant:
+                    by_tenant[s.tenant] = []
+                    order.append(s.tenant)
+                by_tenant[s.tenant].append(s)
+            if not order:
+                break
+            # split the remaining slots into per-tenant credit by
+            # weight: total inflow == remaining capacity, so deficits
+            # hover near zero under load instead of growing unboundedly
+            remaining = k - off
+            wsum = sum(self._tenant_weight(t) for t in order)
+            for t in order:
+                self._deficit[t] = (
+                    self._deficit.get(t, 0.0)
+                    + remaining * self._tenant_weight(t) / wsum
+                )
+            for t in order:
+                budget = min(int(self._deficit[t]), k - off)
+                granted = 0
+                slots = by_tenant[t]
+                # per-request fair share inside the tenant's grant
+                while granted < budget:
+                    t_live = [s for s in slots if s.next < s.n]
+                    if not t_live:
+                        break
+                    share = max(1, (budget - granted) // len(t_live))
+                    for slot in t_live:
+                        take = min(
+                            share, slot.n - slot.next, budget - granted
+                        )
+                        if take <= 0:
+                            continue
+                        pack(slot, take)
+                        granted += take
+                        if granted >= budget:
+                            break
+                self._deficit[t] -= granted
                 if off >= k:
                     break
         self._pool = [s for s in self._pool if s.next < s.n]
+        # drained tenants forfeit leftover credit (classic DRR reset)
+        active = {s.tenant for s in self._pool}
+        for t in list(self._deficit):
+            if t not in active:
+                self._deficit[t] = 0.0
         return spans
 
     def _predict_slab(self, total: int) -> np.ndarray:
@@ -526,6 +690,11 @@ class ContinuousBatcher:
         for slot, _, _, _ in spans:
             if slot.filled == slot.n and not slot.done.is_set():
                 slot.done.set()
+        tenant_windows: Dict[str, int] = {}
+        for slot, _, count, _ in spans:
+            tenant_windows[slot.tenant] = (
+                tenant_windows.get(slot.tenant, 0) + count
+            )
         with self._cv:
             wps = total / max(dt, 1e-6)
             self._ema_wps = (
@@ -534,6 +703,17 @@ class ContinuousBatcher:
                 else _THROUGHPUT_BETA * self._ema_wps
                 + (1 - _THROUGHPUT_BETA) * wps
             )
+            # per-tenant drain rate: the tenant's windows in THIS step
+            # over the step time — what its Retry-After divides by
+            for t, n in tenant_windows.items():
+                t_wps = n / max(dt, 1e-6)
+                prev = self._tenant_wps.get(t)
+                self._tenant_wps[t] = (
+                    t_wps
+                    if prev is None
+                    else _THROUGHPUT_BETA * prev
+                    + (1 - _THROUGHPUT_BETA) * t_wps
+                )
             for sid in done_ids:
                 self._live.pop(sid, None)
             self._rung_history.append({

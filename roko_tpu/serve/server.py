@@ -56,6 +56,8 @@ from __future__ import annotations
 import base64
 import contextlib
 import json
+import os
+import re
 import signal
 import sys
 import threading
@@ -73,7 +75,7 @@ from roko_tpu.infer import VoteBoard
 from roko_tpu.obs import events as obs_events
 from roko_tpu.obs.trace import RequestTrace, TraceRing, new_request_id
 from roko_tpu.resilience import CircuitBreaker
-from roko_tpu.serve.batcher import Backpressure, MicroBatcher
+from roko_tpu.serve.batcher import Backpressure, MicroBatcher, QuotaExceeded
 from roko_tpu.serve.metrics import ServeMetrics
 from roko_tpu.serve.session import PolishSession
 
@@ -99,6 +101,52 @@ WARMING_RETRY_AFTER_S = 30.0
 
 class _BadRequest(ValueError):
     pass
+
+
+#: tenant / model-name grammar shared with the registry's _NAME_RE: a
+#: malformed id is a client bug (400), never a new accounting bucket
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+
+def request_tenant(headers, payload: Dict[str, Any]) -> Optional[str]:
+    """The request's tenant id: ``X-Roko-Tenant`` header first (the
+    fleet's canonical channel — the front end must not parse a 256 MiB
+    body to route), then the payload's ``tenant`` field; None = the
+    default tenant. Malformed ids refuse with 400 rather than opening
+    an unbounded label namespace."""
+    tenant = headers.get("X-Roko-Tenant") or payload.get("tenant")
+    if tenant is None:
+        return None
+    if not isinstance(tenant, str) or not _NAME_RE.match(tenant):
+        raise _BadRequest(
+            "tenant id must match [A-Za-z0-9][A-Za-z0-9._-]{0,63}"
+        )
+    return tenant
+
+
+def check_model_pin(headers, payload: Dict[str, Any], own: Optional[str]) -> None:
+    """Worker-side model-lane guard: a request pinned to ``model=``
+    must land on a worker RUNNING that version — anything else refuses
+    loudly in the RegistryMismatch shape (docs/SERVING.md), never
+    silently serves the incumbent."""
+    model = headers.get("X-Roko-Model") or payload.get("model")
+    if model is None:
+        return
+    if not isinstance(model, str) or not _NAME_RE.match(model):
+        raise _BadRequest(
+            "model name must match [A-Za-z0-9][A-Za-z0-9._-]{0,63}"
+        )
+    if own is None:
+        raise _BadRequest(
+            f"RegistryMismatch: request pinned model={model!r} but this "
+            "worker has no registry version identity (started outside "
+            "a versioned rollout)"
+        )
+    if model != own:
+        raise _BadRequest(
+            f"RegistryMismatch: request pinned model={model!r} but this "
+            f"worker runs {own!r}"
+        )
 
 
 def _decode_array(
@@ -177,21 +225,32 @@ def _cascade_override(payload: Dict[str, Any], router):
 
 
 def _batch_predict(
-    batcher: MicroBatcher, x, trace=None, router=None,
+    batcher: MicroBatcher, x, trace=None, router=None, tenant=None,
 ):
     """One predict through the batching plane, cascaded when a router
-    is attached — the single chokepoint all three /polish shapes use."""
+    is attached — the single chokepoint all three /polish shapes use.
+    ``tenant`` rides into ``submit`` for fair-share accounting; the
+    router path closes over it because the router's submit_fn contract
+    is ``(x, trace=)``."""
     if router is None:
-        return batcher.predict(x, timeout=REQUEST_TIMEOUT_S, trace=trace)
-    return router.predict(
-        x, batcher.submit, timeout=REQUEST_TIMEOUT_S, trace=trace
+        return batcher.submit(
+            x, trace=trace, tenant=tenant
+        ).result(REQUEST_TIMEOUT_S)
+    submit = (
+        batcher.submit
+        if tenant is None
+        else lambda xs, trace=None: batcher.submit(
+            xs, trace=trace, tenant=tenant
+        )
     )
+    return router.predict(x, submit, timeout=REQUEST_TIMEOUT_S, trace=trace)
 
 
 def _polish_windows(
     batcher: MicroBatcher, payload: Dict[str, Any],
     trace: Optional[RequestTrace] = None,
     router=None,
+    tenant: Optional[str] = None,
 ) -> Dict[str, Any]:
     cfg = batcher.session.cfg.model
     draft = payload.get("draft")
@@ -224,7 +283,9 @@ def _polish_windows(
                 f"positions out of range: pos must lie in [0, {len(draft)})"
                 f" (draft length) and ins in [0, {C.MAX_INS}]"
             )
-    preds = _batch_predict(batcher, examples, trace=trace, router=router)
+    preds = _batch_predict(
+        batcher, examples, trace=trace, router=router, tenant=tenant
+    )
     t0 = time.perf_counter()
     board = VoteBoard({contig: draft})
     board.add([contig] * n, positions, preds)
@@ -283,6 +344,7 @@ def _polish_bam(
     data_root: Optional[str] = None,
     trace: Optional[RequestTrace] = None,
     router=None,
+    tenant: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Extractor convenience path: feature-extract a server-local
     ref+BAM through ``features.pipeline`` and polish every contig
@@ -321,7 +383,9 @@ def _polish_bam(
         ):
             board.add(
                 names, positions,
-                _batch_predict(batcher, x, trace=trace, router=router),
+                _batch_predict(
+                    batcher, x, trace=trace, router=router, tenant=tenant
+                ),
             )
         t0 = time.perf_counter()
         contigs = board.stitch_all()
@@ -335,6 +399,7 @@ def _polish_unit(
     data_root: Optional[str] = None,
     trace: Optional[RequestTrace] = None,
     router=None,
+    tenant: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Worker-side execution of ONE distributed-polish work unit
     (roko_tpu/pipeline/distpolish.py, docs/PIPELINE.md "Distributed
@@ -387,7 +452,10 @@ def _polish_unit(
     # batching plane's admission bounds (the _polish_bam rule)
     top = session.ladder[-1]
     chunks = [
-        _batch_predict(batcher, x[i:i + top], trace=trace, router=router)
+        _batch_predict(
+            batcher, x[i:i + top], trace=trace, router=router,
+            tenant=tenant,
+        )
         for i in range(0, n, top)
     ]
     preds = (
@@ -560,6 +628,27 @@ class _Handler(JsonRequestHandler):
                 # the fleet supervisor's own 503s can promise a real
                 # wait instead of the static config guess
                 body["retry_after_s"] = round(float(hint), 3)
+            backlog_fn = getattr(self.batcher, "backlog_windows", None)
+            if callable(backlog_fn):
+                # autoscaler inputs: queued-window backlog + occupancy
+                # ride the same probe the supervisor already makes
+                body["queue_windows"] = int(backlog_fn())
+                occ = getattr(self.batcher, "occupancy", None)
+                if callable(occ):
+                    body["occupancy"] = round(float(occ()), 4)
+            tb_fn = getattr(self.batcher, "tenant_backlogs", None)
+            tr_fn = getattr(self.batcher, "tenant_retry_after_s", None)
+            if callable(tb_fn) and callable(tr_fn):
+                # per-tenant backlog + drain-rate Retry-After, cached
+                # by the fleet's health checker so front-end 503/429s
+                # quote the TENANT's wait, not the global queue's
+                body["tenants"] = {
+                    t: {
+                        "backlog_windows": n,
+                        "retry_after_s": round(float(tr_fn(t)), 3),
+                    }
+                    for t, n in sorted(tb_fn().items())
+                }
             code = 200
             if breaker is not None:
                 body["breaker"] = breaker.state
@@ -714,20 +803,27 @@ class _Handler(JsonRequestHandler):
             payload = json.loads(raw.decode())
             if not isinstance(payload, dict):
                 raise _BadRequest("payload must be a JSON object")
+            tenant = request_tenant(self.headers, payload)
+            check_model_pin(
+                self.headers, payload, self.metrics.model_version
+            )
+            trace.tenant = tenant
+            trace.model = self.metrics.model_version
             router = _cascade_override(payload, self.router)
             if "unit" in payload:
                 result = _polish_unit(
                     self.batcher, payload, self.data_root, trace=trace,
-                    router=router,
+                    router=router, tenant=tenant,
                 )
             elif "bam" in payload:
                 result = _polish_bam(
                     self.batcher, payload, self.data_root, trace=trace,
-                    router=router,
+                    router=router, tenant=tenant,
                 )
             else:
                 result = _polish_windows(
-                    self.batcher, payload, trace=trace, router=router
+                    self.batcher, payload, trace=trace, router=router,
+                    tenant=tenant,
                 )
             trace.windows = int(result.get("windows", 0))
             result["request_id"] = rid
@@ -735,6 +831,17 @@ class _Handler(JsonRequestHandler):
             if self.ring is not None:
                 self.ring.record(trace)
             self._reply_json(200, result)
+        except QuotaExceeded as e:
+            # the TENANT's quota, not global overload: 429 so clients
+            # (and the fleet front end) can tell throttling from an
+            # unhealthy service; Retry-After is the tenant's own drain
+            # estimate
+            self._reply_json(
+                429,
+                {"error": str(e), "tenant": e.tenant,
+                 "retry_after_s": e.retry_after_s},
+                extra={"Retry-After": f"{max(1, round(e.retry_after_s))}"},
+            )
         except Backpressure as e:
             self._reply_json(
                 503,
@@ -792,6 +899,11 @@ def make_server(
     metrics = metrics or ServeMetrics(latency_samples=serve_cfg.latency_samples)
     # per-size-class latency buckets follow the session's ladder rungs
     metrics.size_classes = tuple(session.ladder)
+    # registry version identity (fleet spawns export it per launch
+    # spec): labels the latency histogram per model and arms the
+    # worker-side model-lane pin guard
+    if metrics.model_version is None:
+        metrics.model_version = os.environ.get("ROKO_MODEL_VERSION") or None
     if batcher is None:
         if breaker is None and rcfg.breaker_failures > 0:
             breaker = CircuitBreaker(

@@ -42,10 +42,12 @@ the way down.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import os
 import sys
 import threading
+import time
 from http.server import ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -71,9 +73,11 @@ from roko_tpu.serve.rollout import (
     recover_rollout,
 )
 from roko_tpu.serve.server import (
+    _NAME_RE,
     JsonRequestHandler,
     drain,
     init_lifecycle,
+    request_tenant,
     serve_forever,
 )
 
@@ -162,6 +166,22 @@ class _FrontHandler(JsonRequestHandler):
             self._reply_json(404, {"error": f"no route {self.path}"})
             return
         fleet = self.fleet
+        # tenant / model-lane identity ride in headers — the front end
+        # never parses the (possibly 256 MiB) body to route
+        try:
+            tenant = request_tenant(self.headers, {})
+        except ValueError as e:
+            self._reply_json(400, {"error": str(e)})
+            return
+        model = self.headers.get("X-Roko-Model")
+        pinned = model is not None
+        if pinned and not _NAME_RE.match(model):
+            self._reply_json(
+                400,
+                {"error": "model name must match "
+                          "[A-Za-z0-9][A-Za-z0-9._-]{0,63}"},
+            )
+            return
         with self._track_inflight():
             # draining checked AFTER the increment (same TOCTOU rule as
             # the worker server: drain() watches the counter)
@@ -169,9 +189,11 @@ class _FrontHandler(JsonRequestHandler):
                 self.close_connection = True
                 # live hint: the max Retry-After any up worker last
                 # reported (static config value when none have
-                # answered) — computed only on the 503 paths, never the
-                # hot success path (it sweeps every worker's waitpid)
-                retry = fleet.live_retry_after_s()
+                # answered) — sized from the REQUESTING tenant's backlog
+                # and drain rate when the workers report per-tenant
+                # hints; computed only on the 503 paths, never the hot
+                # success path (it sweeps every worker's waitpid)
+                retry = fleet.live_retry_after_s(tenant)
                 self._reply_json(
                     503,
                     {"error": "fleet draining", "retry_after_s": retry},
@@ -185,7 +207,7 @@ class _FrontHandler(JsonRequestHandler):
                 # capacity, shed here instead of stacking relays behind
                 # workers that will 503 anyway
                 fleet.inc("rejected")
-                retry = fleet.live_retry_after_s()
+                retry = fleet.live_retry_after_s(tenant)
                 self._reply_json(
                     503,
                     {"error": "fleet at capacity",
@@ -193,6 +215,14 @@ class _FrontHandler(JsonRequestHandler):
                     extra={"Retry-After": f"{max(1, round(retry))}"},
                 )
                 return
+            if pinned:
+                # the pin resolves through the registry HERE — an
+                # unregistered or digest-drifted version refuses loudly
+                # before any worker sees the request
+                err = self.server._verify_model(model)  # type: ignore[attr-defined]
+                if err is not None:
+                    self._reply_json(400, {"error": err})
+                    return
             try:
                 body = self._read_body()
             except TimeoutError:
@@ -212,7 +242,23 @@ class _FrontHandler(JsonRequestHandler):
             rid = (
                 self.headers.get("X-Roko-Request-Id") or new_request_id()
             )
-            code, reply, extra = fleet.post_polish(body, request_id=rid)
+            version = model if pinned else None
+            if version is None:
+                lane = self.server._ab_lane  # type: ignore[attr-defined]
+                if lane is not None:
+                    # deterministic split: the request id (stable across
+                    # failover) hashes into [0,1) against the configured
+                    # fraction — no RNG, replayable from the event log
+                    lane_version, fraction = lane
+                    h = int(
+                        hashlib.sha256(rid.encode()).hexdigest()[:8], 16
+                    )
+                    if h / float(1 << 32) < fraction:
+                        version = lane_version
+            code, reply, extra = fleet.post_polish(
+                body, request_id=rid, tenant=tenant,
+                model_version=version, pinned=pinned,
+            )
             if code == 503:
                 self.close_connection = True
             self._reply(code, reply, extra=extra)
@@ -242,8 +288,51 @@ def make_front_server(
     #: POST /job implementation (distributed polish); run_supervisor
     #: wires it, bare front ends answer 501
     server._start_job = None  # type: ignore[attr-defined]
+    #: (version, fraction) when an A/B lane routes a slice of unpinned
+    #: traffic to a candidate version; run_supervisor wires it
+    server._ab_lane = None  # type: ignore[attr-defined]
+    #: X-Roko-Model pin verifier: name -> error string or None (pass);
+    #: re-verifies the registry entry (bundle digest + params manifest)
+    #: with a short-lived cache so pinned traffic does not re-hash the
+    #: checkpoint per request
+    server._verify_model = make_model_verifier(fleet)  # type: ignore[attr-defined]
     init_lifecycle(server, fleet.cfg.resilience.drain_deadline_s)
     return server
+
+
+def make_model_verifier(
+    fleet: Fleet, ttl_s: float = 10.0
+) -> Callable[[str], Optional[str]]:
+    """Front-end ``model=`` pin gate: resolve the named version through
+    the registry with full verification (bundle digest + params
+    manifest re-hash) and cache the verdict for ``ttl_s`` — drift is
+    caught within one TTL, and pinned hot paths do not re-hash a
+    checkpoint per request. Returns an error string in the
+    RegistryMismatch shape, or None when the pin is valid."""
+    cache: Dict[str, Tuple[float, Optional[str]]] = {}
+    lock = threading.Lock()
+
+    def verify(name: str) -> Optional[str]:
+        now = time.monotonic()
+        with lock:
+            hit = cache.get(name)
+            if hit is not None and hit[0] > now:
+                return hit[1]
+        try:
+            resolve_model(
+                resolve_registry_dir(fleet.fleet_cfg.registry_dir), name
+            )
+            err: Optional[str] = None
+        except RegistryError as e:
+            # unregistered AND drifted both refuse in the same loud
+            # shape — the one thing that never happens is silently
+            # serving the incumbent under a pinned name
+            err = f"RegistryMismatch: model={name!r} refused: {e}"
+        with lock:
+            cache[name] = (now + ttl_s, err)
+        return err
+
+    return verify
 
 
 def worker_command(
@@ -297,10 +386,15 @@ def worker_launch_spec(
         "model": dataclasses.asdict(cfg.model),
     }
     spec_meta.update(meta or {})
+    # device slices are carved for the fleet's MAX size: an autoscaled
+    # worker's fresh id must map to a valid slice, and a fixed-size
+    # fleet (max_workers unset) keeps the old denominator (CPU fleets
+    # pass devices_per_worker=0 -> empty overlay either way)
+    n_slices = max(fc.workers, fc.max_workers or 0)
     return WorkerLaunchSpec(
         worker_command(model_path, config_path),
         env=lambda wid: fleet_worker_env(
-            wid, fc.workers, fc.devices_per_worker
+            wid, n_slices, fc.devices_per_worker
         ),
         version=version,
         meta=spec_meta,
@@ -435,6 +529,160 @@ def make_rollout_starter(
     return start
 
 
+class Autoscaler:
+    """Backlog-driven worker-count control loop (docs/SERVING.md
+    "Multi-tenant & elastic fleet").
+
+    Pure decision logic over an injected fleet + clock so tests drive
+    it synchronously: each :meth:`tick` smooths backlog-per-worker with
+    an EMA, then
+
+    - **scales UP fast** — +1 worker whenever the smoothed backlog
+      exceeds ``autoscale_up_backlog`` windows/worker, the cooldown has
+      passed, and the fleet is below ``max_workers``;
+    - **scales DOWN slowly** — −1 worker only after the smoothed
+      backlog has stayed at or below ``autoscale_down_backlog`` for a
+      CONTINUOUS ``autoscale_idle_s`` stretch (any excursion above
+      resets the stretch), re-arming the stretch per step down;
+    - **parks background jobs** — ``fleet.jobs_parked`` flips on when
+      interactive backlog spikes past the up threshold and off once it
+      falls back under the down threshold; the distpolish journal makes
+      park/resume cost at most one contig re-run.
+
+    The up threshold strictly above the down threshold (enforced by
+    FleetConfig) plus the idle-stretch requirement is the hysteresis
+    band: oscillating load rides inside it without flapping the worker
+    count. Enabled only when the configured bounds leave room
+    (``max_workers > min_workers``)."""
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        *,
+        log: Callable[[str], None] = print,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        fc = fleet.fleet_cfg
+        self.fleet = fleet
+        self.fc = fc
+        self.min_workers = max(1, fc.min_workers or fc.workers)
+        self.max_workers = max(
+            self.min_workers, fc.max_workers or fc.workers
+        )
+        self.enabled = self.max_workers > self.min_workers
+        self._log = log
+        self._clock = clock
+        self.ema: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_change: Optional[float] = None
+
+    def tick(self, now: Optional[float] = None) -> Optional[str]:
+        """One control decision; returns ``"up"``/``"down"`` when the
+        fleet was resized, None otherwise (parking alone returns
+        None)."""
+        fc = self.fc
+        fleet = self.fleet
+        now = self._clock() if now is None else now
+        n = len(fleet.workers)
+        per_worker = fleet.backlog_windows() / max(1, n)
+        if self.ema is None:
+            self.ema = float(per_worker)
+        else:
+            self.ema = (
+                fc.autoscale_ema_beta * self.ema
+                + (1.0 - fc.autoscale_ema_beta) * per_worker
+            )
+        ema = self.ema
+        # park/resume is independent of sizing headroom: even a fleet
+        # pinned at max_workers sheds its background job while
+        # interactive backlog spikes
+        if ema > fc.autoscale_up_backlog:
+            if not fleet.jobs_parked:
+                fleet.jobs_parked = True
+                self._log(
+                    f"roko autoscale: backlog {ema:.1f} windows/worker — "
+                    "parking background jobs"
+                )
+        elif ema <= fc.autoscale_down_backlog and fleet.jobs_parked:
+            fleet.jobs_parked = False
+            self._log(
+                "roko autoscale: backlog drained — resuming background "
+                "jobs"
+            )
+        if not self.enabled:
+            return None
+        cooled = (
+            self._last_change is None
+            or now - self._last_change >= fc.autoscale_cooldown_s
+        )
+        if ema > fc.autoscale_up_backlog:
+            self._idle_since = None
+            if n < self.max_workers and cooled:
+                fleet.scale_to(
+                    n + 1,
+                    reason=f"backlog {ema:.1f} windows/worker > "
+                           f"{fc.autoscale_up_backlog:g}",
+                )
+                self._last_change = now
+                return "up"
+            return None
+        if ema > fc.autoscale_down_backlog:
+            # inside the hysteresis band: hold, and any prior idle
+            # stretch is void
+            self._idle_since = None
+            return None
+        if self._idle_since is None:
+            self._idle_since = now
+            return None
+        if (
+            n > self.min_workers
+            and cooled
+            and now - self._idle_since >= fc.autoscale_idle_s
+        ):
+            fleet.scale_to(
+                n - 1,
+                reason=f"backlog {ema:.1f} windows/worker idle for "
+                       f"{now - self._idle_since:.0f}s",
+            )
+            self._last_change = now
+            self._idle_since = now  # next step down needs a fresh stretch
+            return "down"
+        return None
+
+
+def start_autoscaler(
+    fleet: Fleet,
+    stop: threading.Event,
+    *,
+    log: Callable[[str], None] = print,
+) -> Optional[Autoscaler]:
+    """Spin the autoscale control thread when the config leaves room
+    (``max_workers > min_workers`` effective); returns the Autoscaler
+    (or None when fixed-size)."""
+    scaler = Autoscaler(fleet, log=log)
+    if not scaler.enabled:
+        return None
+
+    def loop() -> None:
+        while not stop.is_set():
+            try:
+                scaler.tick()
+            except Exception as e:  # pragma: no cover - defensive
+                log(f"roko autoscale: tick failed: {e!r}")
+            stop.wait(fleet.fleet_cfg.autoscale_interval_s)
+
+    threading.Thread(
+        target=loop, name="roko-fleet-autoscale", daemon=True
+    ).start()
+    log(
+        f"roko autoscale: elastic fleet {scaler.min_workers}.."
+        f"{scaler.max_workers} workers (up>"
+        f"{fleet.fleet_cfg.autoscale_up_backlog:g}, down<="
+        f"{fleet.fleet_cfg.autoscale_down_backlog:g} windows/worker)"
+    )
+    return scaler
+
+
 def rolling_drain(
     server: ThreadingHTTPServer, fleet: Fleet, log=print
 ) -> None:
@@ -516,8 +764,43 @@ def run_supervisor(
             boot_version, boot_model, boot_cfg, fleet.runtime_dir
         )
     )
+    if fc.ab_version:
+        # A/B model lane: register the candidate version's launch spec
+        # and re-target the highest-id worker slice BEFORE start(), so
+        # the lane boots in one spawn sweep. A bad lane config refuses
+        # the whole boot — a supervisor silently serving 100% incumbent
+        # under a configured experiment is the failure mode to refuse.
+        try:
+            entry = resolve_model(
+                resolve_registry_dir(fc.registry_dir), fc.ab_version
+            )
+        except RegistryError as e:
+            raise RegistryError(
+                f"--ab-lane version {fc.ab_version!r} refused: {e}"
+            ) from e
+        fleet.add_launch_spec(
+            worker_launch_spec(
+                fc.ab_version,
+                entry.get("params_path") or boot_model,
+                _version_config(boot_cfg, entry),
+                fleet.runtime_dir,
+                meta={"bundle_digest": entry["bundle_digest"]},
+            )
+        )
+        n_ab = min(
+            max(1, round(fc.ab_fraction * len(fleet.workers))),
+            max(0, len(fleet.workers) - 1),
+        )
+        for w in fleet.workers[len(fleet.workers) - n_ab:]:
+            w.version = w.target_version = fc.ab_version
+        log(
+            f"roko fleet: A/B lane {fc.ab_version!r} on {n_ab} "
+            f"worker(s), {fc.ab_fraction:.0%} of unpinned traffic"
+        )
 
     server = make_front_server(fleet)
+    if fc.ab_version and fc.ab_fraction > 0:
+        server._ab_lane = (fc.ab_version, fc.ab_fraction)  # type: ignore[attr-defined]
     # the starter's fallback identity is what the fleet actually BOOTED
     # (a recovered/pinned version, not necessarily the CLI args)
     server._start_rollout = make_rollout_starter(  # type: ignore[attr-defined]
@@ -544,6 +827,10 @@ def run_supervisor(
         # every worker just spawned from the one recovered spec — the
         # fleet is uniform again and the journal has done its job
         journal.delete()
+    autoscale_stop = threading.Event()
+    fleet.autoscaler = start_autoscaler(  # type: ignore[attr-defined]
+        fleet, autoscale_stop, log=log
+    )
     try:
         serve_forever(
             server,
@@ -551,6 +838,7 @@ def run_supervisor(
             drain_fn=lambda: rolling_drain(server, fleet, log=log),
         )
     finally:
+        autoscale_stop.set()
         # Ctrl-C / accept-loop exit: make sure no worker outlives the
         # supervisor (stop() is idempotent — a completed rolling drain
         # already did this)

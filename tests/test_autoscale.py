@@ -1,0 +1,268 @@
+"""Autoscaler decision units (roko_tpu/serve/supervisor.py,
+docs/SERVING.md "Multi-tenant & elastic fleet").
+
+The control loop is pure decision logic over an injected fleet +
+clock, so every property — scale-up speed, the idle-stretch
+requirement on scale-down, hysteresis-band holds, cooldown pacing,
+flap resistance under oscillating load, and background-job
+park/resume — is driven synchronously against a jax-free fake fleet
+with a synthetic clock. No processes, no sleeps, no timing races.
+
+The slow ``autoscale-gate`` e2e (a REAL elastic fleet scaling 2→3→1
+under a bulk flood while an interactive tenant stays served and a
+distpolish job parks and resumes) lives in tests/test_fleet.py.
+"""
+
+import dataclasses
+
+from roko_tpu.config import FleetConfig
+from roko_tpu.serve.supervisor import Autoscaler
+
+
+def _quiet(*_a, **_k):
+    pass
+
+
+#: fast, test-friendly elastic band: up at >8 windows/worker, down at
+#: <=2 after a 5s continuous idle stretch, 2s cooldown, no smoothing
+#: lag (beta=0 -> the EMA IS the instantaneous observation)
+FC = FleetConfig(
+    workers=2, min_workers=1, max_workers=4,
+    autoscale_up_backlog=8.0, autoscale_down_backlog=2.0,
+    autoscale_idle_s=5.0, autoscale_cooldown_s=2.0,
+    autoscale_ema_beta=0.0,
+)
+
+
+class ScaleFleet:
+    """The narrow surface Autoscaler consumes: fleet_cfg, workers,
+    backlog_windows(), jobs_parked, scale_to() — the same contract the
+    real Fleet honours, recording every resize."""
+
+    def __init__(self, fc=FC, n=None):
+        self.fleet_cfg = fc
+        self.workers = list(range(fc.workers if n is None else n))
+        self.jobs_parked = False
+        self.backlog = 0
+        self.resizes = []
+
+    def backlog_windows(self):
+        return self.backlog
+
+    def scale_to(self, n, reason=""):
+        self.resizes.append((len(self.workers), n, reason))
+        self.workers = list(range(n))
+        return n
+
+
+def make_scaler(fleet):
+    """Autoscaler on a synthetic clock the test advances by hand."""
+    clock = [0.0]
+    scaler = Autoscaler(fleet, log=_quiet, clock=lambda: clock[0])
+    return scaler, clock
+
+
+# -- enablement ---------------------------------------------------------------
+
+
+def test_disabled_without_headroom():
+    """min == max (or both unset) leaves no room: the scaler reports
+    disabled and never resizes, whatever the backlog does."""
+    fixed = dataclasses.replace(FC, min_workers=2, max_workers=2)
+    fleet = ScaleFleet(fixed)
+    scaler, clock = make_scaler(fleet)
+    assert not scaler.enabled
+    fleet.backlog = 10_000
+    for _ in range(20):
+        clock[0] += 10.0
+        assert scaler.tick() is None
+    assert fleet.resizes == []
+
+
+def test_bounds_default_from_workers():
+    """min_workers 0 with a max set floors at the static worker count
+    (a configured fleet never shrinks below what was asked for)."""
+    fc = dataclasses.replace(FC, min_workers=0)
+    scaler, _ = make_scaler(ScaleFleet(fc))
+    assert scaler.min_workers == fc.workers
+    assert scaler.max_workers == 4
+
+
+# -- scale-up -----------------------------------------------------------------
+
+
+def test_scales_up_fast_on_backlog_spike():
+    """One tick over the up threshold is enough: +1 worker immediately,
+    no waiting period on the way up."""
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    fleet.backlog = 40  # 20 windows/worker > 8
+    assert scaler.tick() == "up"
+    assert len(fleet.workers) == 3
+
+
+def test_scale_up_stops_at_max_workers():
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    fleet.backlog = 10_000
+    for _ in range(10):
+        clock[0] += FC.autoscale_cooldown_s
+        scaler.tick()
+    assert len(fleet.workers) == 4
+    assert all(new <= 4 for _, new, _ in fleet.resizes)
+
+
+def test_cooldown_paces_consecutive_steps():
+    """Two up decisions inside one cooldown window collapse to one —
+    the second tick holds even though the threshold is still crossed."""
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    fleet.backlog = 10_000
+    assert scaler.tick() == "up"
+    clock[0] += FC.autoscale_cooldown_s / 2
+    assert scaler.tick() is None  # still cooling
+    clock[0] += FC.autoscale_cooldown_s
+    assert scaler.tick() == "up"
+
+
+# -- scale-down ---------------------------------------------------------------
+
+
+def _grow_to(fleet, scaler, clock, n):
+    fleet.backlog = 10_000
+    while len(fleet.workers) < n:
+        clock[0] += FC.autoscale_cooldown_s
+        scaler.tick()
+    fleet.backlog = 0
+
+
+def test_scale_down_requires_sustained_idle():
+    """Backlog at zero does NOT shrink the fleet until the idle
+    stretch has lasted autoscale_idle_s continuously."""
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    _grow_to(fleet, scaler, clock, 3)
+    clock[0] += FC.autoscale_cooldown_s
+    assert scaler.tick() is None  # arms the stretch
+    clock[0] += FC.autoscale_idle_s / 2
+    assert scaler.tick() is None  # idle, but not LONG enough
+    clock[0] += FC.autoscale_idle_s
+    assert scaler.tick() == "down"
+    assert len(fleet.workers) == 2
+
+
+def test_each_step_down_needs_a_fresh_stretch():
+    """The idle stretch re-arms after every step down: a 4-worker fleet
+    does not collapse straight to min in one long-idle tick."""
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    _grow_to(fleet, scaler, clock, 4)
+    downs = 0
+    for _ in range(40):
+        clock[0] += 1.0
+        if scaler.tick() == "down":
+            downs += 1
+            # the very next tick must never double-step
+            clock[0] += 0.5
+            assert scaler.tick() is None
+    assert downs == 3 and len(fleet.workers) == scaler.min_workers
+
+
+def test_excursion_voids_idle_stretch():
+    """Any excursion above the down threshold — even inside the
+    hysteresis band, without triggering an up — resets the idle clock
+    to zero."""
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    _grow_to(fleet, scaler, clock, 3)
+    clock[0] += FC.autoscale_cooldown_s
+    scaler.tick()  # arm
+    clock[0] += FC.autoscale_idle_s - 1.0
+    fleet.backlog = 5 * len(fleet.workers)  # band: 2 < 5 <= 8
+    assert scaler.tick() is None
+    fleet.backlog = 0
+    clock[0] += 2.0  # idle_s would long since have elapsed pre-reset
+    assert scaler.tick() is None  # stretch restarted from the excursion
+    clock[0] += FC.autoscale_idle_s
+    assert scaler.tick() == "down"
+
+
+def test_never_flaps_under_oscillating_load():
+    """Load bouncing across the band every tick must not bounce the
+    worker count: the up/down thresholds + idle stretch are the
+    hysteresis. At most the initial climb, never an up-down-up saw."""
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    sizes = [len(fleet.workers)]
+    for i in range(60):
+        clock[0] += 1.0
+        fleet.backlog = (10 if i % 2 == 0 else 0) * len(fleet.workers)
+        scaler.tick()
+        sizes.append(len(fleet.workers))
+    # direction changes along the size trajectory: a clean climb has
+    # exactly one monotone run; flapping shows up as many reversals
+    deltas = [b - a for a, b in zip(sizes, sizes[1:]) if b != a]
+    reversals = sum(
+        1 for a, b in zip(deltas, deltas[1:]) if (a > 0) != (b > 0)
+    )
+    assert reversals == 0, f"worker count flapped: {sizes}"
+    # and the oscillation (which never leaves a sustained idle stretch)
+    # must not have scaled the fleet down at all
+    assert all(d > 0 for d in deltas)
+
+
+# -- background-job parking ---------------------------------------------------
+
+
+def test_parks_on_spike_resumes_after_drain():
+    fleet = ScaleFleet()
+    scaler, clock = make_scaler(fleet)
+    fleet.backlog = 40
+    scaler.tick()
+    assert fleet.jobs_parked
+    # inside the band: still parked (park honours the same hysteresis)
+    fleet.backlog = 5 * len(fleet.workers)
+    clock[0] += 1.0
+    scaler.tick()
+    assert fleet.jobs_parked
+    fleet.backlog = 0
+    clock[0] += 1.0
+    scaler.tick()
+    assert not fleet.jobs_parked
+
+
+def test_parking_works_even_when_sizing_is_pinned():
+    """A fleet pinned at max_workers (or with the sizing disabled)
+    still sheds its background job on an interactive spike — parking is
+    independent of resize headroom."""
+    fixed = dataclasses.replace(FC, min_workers=2, max_workers=2)
+    fleet = ScaleFleet(fixed)
+    scaler, clock = make_scaler(fleet)
+    fleet.backlog = 40
+    scaler.tick()
+    assert fleet.jobs_parked and fleet.resizes == []
+    fleet.backlog = 0
+    clock[0] += 1.0
+    scaler.tick()
+    assert not fleet.jobs_parked
+
+
+def test_ema_smooths_single_tick_blips():
+    """With real smoothing (beta=0.5) a one-tick backlog blip does not
+    cross the up threshold — the EMA needs sustained pressure."""
+    fc = dataclasses.replace(FC, autoscale_ema_beta=0.5)
+    fleet = ScaleFleet(fc)
+    scaler, clock = make_scaler(fleet)
+    fleet.backlog = 0
+    scaler.tick()  # seed the EMA at 0
+    fleet.backlog = 9 * len(fleet.workers)  # just past the raw threshold
+    clock[0] += 1.0
+    assert scaler.tick() is None  # EMA 4.5 <= 8: no resize yet
+    ticks_to_up = 1
+    while len(fleet.workers) == 2 and ticks_to_up < 10:
+        clock[0] += FC.autoscale_cooldown_s
+        fleet.backlog = 9 * len(fleet.workers)
+        scaler.tick()
+        ticks_to_up += 1
+    # sustained pressure DOES get through, just not on the first tick
+    assert len(fleet.workers) == 3 and ticks_to_up >= 3

@@ -386,6 +386,52 @@ def test_degraded_fleet_lowers_inflight_limit():
     assert job._inflight_limit() == 0
 
 
+def test_parked_fleet_gates_new_dispatch():
+    """The autoscaler's jobs_parked flag zeroes the dispatch budget
+    exactly like a drain — in-flight units finish, new ones hold."""
+    fleet = FakeFleet(2)
+    job = _job(fleet, _cfg(), _refs(("a", 900)), lambda *a: (_ for _ in ()))
+    assert job._inflight_limit() == 4
+    fleet.jobs_parked = True
+    assert job._inflight_limit() == 0
+    fleet.jobs_parked = False
+    assert job._inflight_limit() == 4
+
+
+def test_autoscaler_park_resume_zero_reruns():
+    """ISSUE 19 park/resume: the autoscaler parks the job mid-run — for
+    LONGER than ready_timeout_s, proving a parked job is 'waiting by
+    design' and never trips the no-capacity abort — then resumes, and
+    every contig's transport fires exactly ONCE across the park (the
+    committed ledger means zero re-runs)."""
+    fleet = FakeFleet(1)
+    refs = _refs(("zulu", 900), ("alpha", 900), ("mike", 900))
+    calls = []
+    unparked = threading.Event()
+
+    def unpark():
+        fleet.jobs_parked = False
+        unparked.set()
+
+    def transport(port, payload, timeout):
+        calls.append(payload["unit"]["contig"])
+        if len(calls) == 1:
+            # interactive spike: the autoscaler parks background work;
+            # the 0.5s park comfortably exceeds ready_timeout_s=0.2
+            fleet.jobs_parked = True
+            threading.Timer(0.5, unpark).start()
+        return _polished_reply(payload)
+
+    cfg = _cfg(ready_timeout_s=0.2, inflight_per_worker=1)
+    job = _job(fleet, cfg, refs, transport)
+    polished = job.run()  # would raise "no ready worker" if the park
+    #                       counted as starvation
+    assert unparked.is_set(), "the park never engaged"
+    assert sorted(calls) == ["alpha", "mike", "zulu"]  # once each
+    assert all(u.state == "committed" for u in job.units)
+    assert polished["zulu"] == "POLISHED-zulu"
+
+
 # -- span units: merge + resume ----------------------------------------------
 
 

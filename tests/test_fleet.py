@@ -568,6 +568,52 @@ def test_metrics_aggregation_survives_worker_death_mid_scrape(tmp_path):
         fleet.stop(rolling=False)
 
 
+# -- elastic sizing (stub workers) -------------------------------------------
+
+
+def test_scale_to_spawns_and_retires_stub_workers(tmp_path):
+    """scale_to with the real supervision machinery on stub workers:
+    up spawns fresh workers onto the boot spec, down drain-retires the
+    highest ids LIFO, freed ids (= device slices) are reused on the
+    next grow so ids stay dense, and the derived admission cap tracks
+    the live count."""
+    fleet = make_fleet(tmp_path, workers=2)
+    fleet.start()
+    try:
+        wait_until(lambda: fleet.ready_count() == 2, msg="2 stubs ready")
+        assert fleet.max_inflight == 2 * 8
+        assert fleet.scale_to(3, reason="unit") == 3
+        wait_until(lambda: fleet.ready_count() == 3, msg="3rd stub ready")
+        assert sorted(w.id for w in fleet.workers) == [0, 1, 2]
+        assert fleet.max_inflight == 3 * 8
+        assert fleet.counter("scale_ups") == 1
+        fleet.scale_to(1, reason="unit")
+        assert [w.id for w in fleet.workers] == [0]  # LIFO shrink
+        assert fleet.max_inflight == 1 * 8
+        wait_until(
+            lambda: not fleet._retiring, msg="retired workers drained"
+        )
+        assert fleet.counter("scale_downs") == 1
+        # freed slices are reused: the regrow mints ids 1 and 2 again
+        fleet.scale_to(3, reason="unit")
+        assert sorted(w.id for w in fleet.workers) == [0, 1, 2]
+        wait_until(lambda: fleet.ready_count() == 3, msg="regrow ready")
+    finally:
+        fleet.stop(rolling=False)
+
+
+def test_scale_to_refused_while_draining(tmp_path):
+    fleet = make_fleet(tmp_path, workers=2)
+    fleet._draining = True
+    assert fleet.scale_to(3) == 2  # no-op, never grows into a drain
+    assert fleet.counter("scale_ups") == 0
+
+
+def test_scale_to_clamps_at_one(tmp_path):
+    fleet = make_fleet(tmp_path, workers=2)
+    assert fleet.scale_to(0) == 1  # a fleet never scales to nothing
+
+
 # -- real-worker acceptance (slow) -------------------------------------------
 
 TINY = dict(embed_dim=8, read_mlp=(8, 4), hidden_size=16, num_layers=1)
@@ -756,3 +802,202 @@ def test_cli_supervisor_sigterm_drains_clean(tmp_path, rng):
         if proc.poll() is None:
             proc.kill()
             proc.communicate(timeout=30.0)
+
+
+@pytest.mark.slow
+def test_autoscale_gate_elastic_fleet(tmp_path, rng):
+    """The ISSUE 19 autoscale-gate: a REAL 2-worker elastic fleet under
+    a bulk-tenant flood plus an interactive tenant. The backlog-driven
+    Autoscaler must scale 2 -> 3 (the new worker spawns, warms, and
+    serves) and, once the flood drains, back down to 1 — while a
+    distpolish job over the same fleet is parked by the spike and
+    resumes to completion with every contig dispatched exactly once.
+    Zero client-visible errors; every interactive reply byte-identical
+    to the single-process inference path."""
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.infer import run_inference
+    from roko_tpu.pipeline.distpolish import DistPolishJob, split_units
+    from roko_tpu.serve.supervisor import Autoscaler
+
+    cfg, params, fleet = _real_fleet_setup(tmp_path, workers=2)
+    fleet.fleet_cfg = dataclasses.replace(
+        fleet.fleet_cfg,
+        min_workers=1, max_workers=3,
+        autoscale_up_backlog=2.0, autoscale_down_backlog=0.5,
+        autoscale_idle_s=3.0, autoscale_cooldown_s=0.5,
+        autoscale_ema_beta=0.3,
+    )
+
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    positions, x = _serve_windows(rng, 3)
+    # bulk requests big enough (16 device steps each) that the flood
+    # holds REAL queued backlog on the workers between heartbeats — a
+    # tiny request drains before the supervisor ever samples it. The
+    # bulk draft must span the strided positions (128 * 30 + 90).
+    flood_positions, flood_x = _serve_windows(rng, 128)
+    flood_draft = "".join(rng.choice(list("ACGT"), 4000))
+    path = tmp_path / "infer.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", list(positions), list(x), None)
+    expected = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None
+    )["ctg"]
+
+    # distpolish over the SAME fleet: whole-contig units, a synthetic
+    # transport (the unit dispatch protocol, not BAM extraction — this
+    # gate is about the park/resume interaction, covered end-to-end)
+    dcfg = dataclasses.replace(
+        cfg,
+        distpolish=dataclasses.replace(
+            cfg.distpolish, unit_bases=0, park_poll_s=0.02,
+            inflight_per_worker=1,
+        ),
+    )
+    refs = [
+        (f"c{i}", "".join(rng.choice(list("ACGT"), 300))) for i in range(6)
+    ]
+    dispatches = []
+    dispatch_lock = threading.Lock()
+
+    def transport(port, payload, timeout):
+        with dispatch_lock:
+            dispatches.append(payload["unit"]["contig"])
+        time.sleep(0.1)
+        contig = payload["unit"]["contig"]
+        return 200, json.dumps(
+            {"contig": contig, "polished": f"POLISHED-{contig}",
+             "windows": 3}
+        ).encode()
+
+    job = DistPolishJob(
+        fleet, dcfg, ref="draft.fa", bam="reads.bam", seed=0,
+        refs=refs,
+        units=split_units(refs, dcfg.region, 0),
+        transport=transport, log=lambda m: None,
+    )
+
+    fleet.start()
+    server = thread = None
+    scaler = Autoscaler(fleet, log=lambda m: None)
+    assert scaler.enabled
+    stop_flood = threading.Event()
+    errors = []
+    interactive_replies = []
+    try:
+        wait_until(
+            lambda: fleet.ready_count() == 2, timeout=180.0,
+            msg="2 real workers warm",
+        )
+        server, thread = start_front(fleet)
+        port = server.server_address[1]
+
+        def bulk_client():
+            client = PolishClient(f"http://127.0.0.1:{port}", timeout=120.0)
+            while not stop_flood.is_set():
+                try:
+                    client.polish(
+                        flood_draft, flood_positions, flood_x, retries=12,
+                        tenant="bulk",
+                    )
+                except Exception as e:
+                    errors.append(f"bulk: {e!r}")
+                    return
+
+        def interactive_client():
+            client = PolishClient(f"http://127.0.0.1:{port}", timeout=120.0)
+            while not stop_flood.is_set():
+                try:
+                    interactive_replies.append(
+                        client.polish(
+                            draft, positions, x, contig="ctg", retries=12,
+                            tenant="interactive",
+                        )
+                    )
+                except Exception as e:
+                    errors.append(f"interactive: {e!r}")
+                    return
+                time.sleep(0.05)
+
+        flood = [
+            threading.Thread(target=bulk_client, daemon=True)
+            for _ in range(6)
+        ] + [threading.Thread(target=interactive_client, daemon=True)]
+        for t in flood:
+            t.start()
+
+        # -- the spike: tick until the scaler grows the fleet to max ----
+        # (ticking starts only once the flood's backlog has registered
+        # in the heartbeat cache, so the scaler sees the spike, not the
+        # idle ramp before it)
+        wait_until(
+            lambda: fleet.backlog_windows() > 0, timeout=60.0,
+            msg="flood backlog visible to the supervisor",
+        )
+        deadline = time.monotonic() + 60.0
+        decisions = []
+        while time.monotonic() < deadline and len(fleet.workers) < 3:
+            d = scaler.tick()
+            if d:
+                decisions.append(d)
+            time.sleep(0.1)
+        assert len(fleet.workers) == 3, (
+            f"no scale-up to max within 60s (ema={scaler.ema}, "
+            f"backlog={fleet.backlog_windows()}, decisions={decisions})"
+        )
+        assert "up" in decisions
+        assert fleet.jobs_parked  # background work parked on the spike
+
+        # the parked distpolish job dispatches NOTHING while the flood
+        # holds — it waits by design instead of aborting
+        job_thread = threading.Thread(target=job.run, daemon=True)
+        job_thread.start()
+        time.sleep(0.6)
+        assert dispatches == []
+
+        # the new worker warms and serves while the flood continues
+        wait_until(
+            lambda: fleet.ready_count() == 3, timeout=180.0,
+            msg="scaled-up worker warm",
+        )
+        for _ in range(3):
+            scaler.tick()
+            time.sleep(0.1)
+
+        # -- the drain: flood off, fleet shrinks to min -----------------
+        stop_flood.set()
+        for t in flood:
+            t.join(120.0)
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and (
+            len(fleet.workers) > 1 or fleet._retiring
+        ):
+            scaler.tick()
+            time.sleep(0.1)
+        assert len(fleet.workers) == 1 and not fleet._retiring
+        assert not fleet.jobs_parked  # resumed with the backlog gone
+        assert fleet.counter("scale_ups") >= 1
+        assert fleet.counter("scale_downs") >= 1
+
+        # the resumed job completes: every contig exactly once — the
+        # committed ledger means the park cost zero re-runs
+        job_thread.join(120.0)
+        assert not job_thread.is_alive()
+        polished = {u.contig: u.state for u in job.units}
+        assert all(s == "committed" for s in polished.values())
+        assert sorted(dispatches) == sorted(r for r, _ in refs)
+
+        # zero client-visible errors, byte-identical interactive replies
+        assert errors == []
+        assert len(interactive_replies) > 0
+        for r in interactive_replies:
+            assert r["polished"] == expected
+        # tenant-labeled fleet series made it through the merge
+        metrics = fleet.render_metrics()
+        assert 'tenant="interactive"' in metrics
+        assert 'tenant="bulk"' in metrics
+    finally:
+        stop_flood.set()
+        if server is not None:
+            stop_front(server, thread)
+        fleet.stop(rolling=False)

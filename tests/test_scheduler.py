@@ -28,6 +28,7 @@ from roko_tpu.config import (
     ModelConfig,
     RokoConfig,
     ServeConfig,
+    TenantConfig,
 )
 from roko_tpu.data.hdf5 import DataWriter
 from roko_tpu.infer import run_inference
@@ -38,6 +39,7 @@ from roko_tpu.serve import (
     MicroBatcher,
     PolishClient,
     PolishSession,
+    QuotaExceeded,
     RaggedBatcher,
     ServeMetrics,
     make_server,
@@ -399,6 +401,167 @@ def test_metrics_padding_efficiency_and_size_classes(rng):
     assert metrics.size_class(2) == "le8"
     assert metrics.size_class(16) == "le16"
     assert metrics.size_class(40) == "gt16"
+
+
+# -- tenant fair-share units (ISSUE 19) ---------------------------------------
+
+
+def _tenant_take(cb, k):
+    """One slot-grant round under the lock; spans grouped into
+    windows-per-tenant so tests assert the DRR split directly."""
+    with cb._cv:
+        spans = cb._take(k)
+    out = {}
+    for slot, _, take, _ in spans:
+        out[slot.tenant] = out.get(slot.tenant, 0) + take
+    return out
+
+
+def test_tenant_weighted_grant_split(rng):
+    """Deficit accounting: a 3:1 weight split grants a 16-slot step
+    ~12:4 when both tenants hold deep backlogs."""
+    cb = make_cb(
+        max_queue=64,
+        tenants=(
+            TenantConfig("gold", weight=3.0),
+            TenantConfig("bulk", weight=1.0),
+        ),
+    )
+    cb.submit(_win(rng, 32), tenant="gold")
+    cb.submit(_win(rng, 32), tenant="bulk")
+    got = _tenant_take(cb, 16)
+    assert got["gold"] == 12 and got["bulk"] == 4
+
+
+def test_tenant_deficit_carries_fractions(rng):
+    """Fractional per-round credit accumulates: equal weights over an
+    odd step size alternate the extra slot instead of always favouring
+    the first-arrived tenant."""
+    cb = make_cb(
+        max_queue=64,
+        tenants=(TenantConfig("a"), TenantConfig("b")),
+    )
+    cb.submit(_win(rng, 40), tenant="a")
+    cb.submit(_win(rng, 40), tenant="b")
+    totals = {"a": 0, "b": 0}
+    for _ in range(4):
+        got = _tenant_take(cb, 5)
+        for t, n in got.items():
+            totals[t] += n
+    # 20 windows granted; the deficit carry keeps the split even
+    assert totals["a"] + totals["b"] == 20
+    assert abs(totals["a"] - totals["b"]) <= 1
+
+
+def test_tenant_drained_forfeits_credit(rng):
+    """A tenant whose backlog drains loses residual credit — it cannot
+    bank idle rounds into a later burst (classic DRR reset)."""
+    cb = make_cb(
+        max_queue=64,
+        tenants=(TenantConfig("gold", weight=4.0), TenantConfig("bulk")),
+    )
+    cb.submit(_win(rng, 2), tenant="gold")
+    cb.submit(_win(rng, 64), tenant="bulk")
+    _tenant_take(cb, 16)  # gold takes its 2 and drains
+    assert cb._deficit.get("gold", 0.0) == 0.0
+    cb.submit(_win(rng, 32), tenant="gold")
+    got = _tenant_take(cb, 16)
+    # fresh round: gold's share is its weighted split, not split + bank
+    assert got["gold"] <= 13
+
+
+def test_tenant_flood_does_not_starve_interactive(rng):
+    """A bulk tenant flooding the pool cannot starve an interactive
+    tenant: the newcomer's windows land in the very next step."""
+    cb = make_cb(
+        max_queue=256,
+        tenants=(
+            TenantConfig("interactive", weight=2.0),
+            TenantConfig("bulk", weight=1.0),
+        ),
+    )
+    for _ in range(6):
+        cb.submit(_win(rng, 16), tenant="bulk")
+    step(cb)
+    fut = cb.submit(_win(rng, 2), tenant="interactive")
+    spans = step(cb)  # the flood is still 5 steps deep
+    assert any(s.tenant == "interactive" for s, _, _, _ in spans)
+    assert fut._req.done.is_set()
+
+
+def test_tenant_interactive_stream_does_not_starve_bulk(rng):
+    """The inverse direction: a heavily-weighted interactive stream
+    still leaves the bulk tenant its share of every step."""
+    cb = make_cb(
+        max_queue=256,
+        tenants=(
+            TenantConfig("interactive", weight=4.0),
+            TenantConfig("bulk", weight=1.0),
+        ),
+    )
+    bulk = cb.submit(_win(rng, 24), tenant="bulk")
+    for _ in range(12):
+        cb.submit(_win(rng, 8), tenant="interactive")
+        step(cb)
+        if bulk._req.done.is_set():
+            break
+    assert bulk._req.done.is_set()
+
+
+def test_tenant_queue_quota_raises_429(rng):
+    """Queued windows beyond the tenant's max_queue raise the typed
+    QuotaExceeded (mapped to HTTP 429) with the tenant's own
+    Retry-After — other tenants keep submitting."""
+    cb = make_cb(
+        max_queue=64,
+        tenants=(TenantConfig("capped", max_queue=8),),
+    )
+    cb.submit(_win(rng, 8), tenant="capped")
+    with pytest.raises(QuotaExceeded) as ei:
+        cb.submit(_win(rng, 1), tenant="capped")
+    assert ei.value.tenant == "capped"
+    assert ei.value.retry_after_s > 0
+    cb.submit(_win(rng, 8), tenant="other")  # global pool still open
+
+
+def test_tenant_inflight_quota_raises_429(rng):
+    """The in-flight cap counts LIVE requests (packed included), not
+    just queued ones."""
+    cb = make_cb(
+        max_queue=64,
+        tenants=(TenantConfig("capped", max_inflight=2),),
+    )
+    cb.submit(_win(rng, 2), tenant="capped")
+    cb.submit(_win(rng, 2), tenant="capped")
+    with pytest.raises(QuotaExceeded):
+        cb.submit(_win(rng, 2), tenant="capped")
+
+
+def test_tenant_backlogs_and_retry_hint(rng):
+    """tenant_backlogs() splits queued windows by tenant, and the
+    per-tenant Retry-After hint scales with the tenant's OWN backlog —
+    a bulk flood never inflates the interactive tenant's hint."""
+    cb = make_cb(max_queue=256)
+    cb.submit(_win(rng, 48), tenant="bulk")
+    cb.submit(_win(rng, 2), tenant="interactive")
+    assert cb.tenant_backlogs() == {"bulk": 48, "interactive": 2}
+    assert (
+        cb.tenant_retry_after_s("interactive")
+        <= cb.tenant_retry_after_s("bulk")
+    )
+
+
+def test_single_tenant_degenerates_to_request_fair_share(rng):
+    """With every request in the default tenant the DRR layer is
+    invisible: one step still carries both a large and a small request
+    exactly like the pre-tenant grant loop."""
+    cb = make_cb()
+    large = cb.submit(_win(rng, 20))
+    small = cb.submit(_win(rng, 2))
+    step(cb)
+    assert small._req.done.is_set() and not large._req.done.is_set()
+    step(cb)
+    assert large._req.done.is_set()
 
 
 # -- ragged packed dispatch policy units --------------------------------------

@@ -94,10 +94,15 @@ def print_tracez(body: dict, label: str = "") -> None:
     )
     slowest = body.get("slowest") or []
     if slowest:
-        print(f"{'request_id':<18} {'windows':>7} {'total':>9}  spans")
+        print(
+            f"{'request_id':<18} {'tenant':<10} {'model':<10} "
+            f"{'windows':>7} {'total':>9}  spans"
+        )
         for rec in slowest:
             print(
                 f"{rec.get('request_id', '?'):<18} "
+                f"{rec.get('tenant') or '-':<10} "
+                f"{rec.get('model') or '-':<10} "
                 f"{rec.get('windows', 0):>7} "
                 f"{_ms(rec.get('total_s')):>9}  "
                 f"{_span_text(rec.get('spans') or {})}"
@@ -160,6 +165,17 @@ def _hist_rows(rows, want_labels):
     )
 
 
+def _label_values(rows, key):
+    """Distinct values of one label across a parsed histogram family
+    (e.g. every tenant with a ``tenant="..."``-labeled bucket set)."""
+    vals = set()
+    for k, _ in rows.items():
+        d = dict(k)
+        if d.get("__series__") == "bucket" and key in d:
+            vals.add(d[key])
+    return sorted(vals)
+
+
 def print_metrics(text: str) -> None:
     print("--- mergeable histograms (fleet-level when scraped from a "
           "supervisor) ---")
@@ -172,6 +188,17 @@ def print_metrics(text: str) -> None:
             if name == "roko_cascade_tier_seconds"
             else [("", {})]
         )
+        if name == "roko_request_latency_seconds":
+            # multi-tenant / model-lane side-by-side: one quantile row
+            # per tenant and per model version beside the aggregate
+            variants += [
+                (f'tenant="{t}"', {"tenant": t})
+                for t in _label_values(rows, "tenant")
+            ]
+            variants += [
+                (f'model="{m}"', {"model": m})
+                for m in _label_values(rows, "model")
+            ]
         for suffix, want in variants:
             buckets = _hist_rows(rows, want)
             if not buckets:
