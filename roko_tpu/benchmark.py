@@ -1462,6 +1462,7 @@ def _emit(result: Dict[str, Any], out_path) -> None:
 # private aliases stay so the orchestration below and the contract
 # tests keep their names.
 from roko_tpu.resilience.probe import (  # noqa: E402
+    last_probe_tail as _last_probe_tail,
     probe_backend as _probe_backend,
     spawn_logged as _spawn_logged,
     tail_file as _tail,
@@ -1493,6 +1494,10 @@ def _probe_backend_once(timeout_s: float, log) -> "tuple":
         + (f"ok on {platform}" if ok else f"failed: {why[:200]}"),
         ok=ok, platform=platform or "unknown",
         why=(why or "")[:200],
+        # the probe child's own stderr/stdout tail as a structured
+        # field: a wedged-probe post-mortem reads the event log, not a
+        # deleted temp file
+        tail=("" if ok else _last_probe_tail()[-600:]),
     )
     return _PROBE_VERDICT
 

@@ -408,6 +408,7 @@ def _build_config(args: argparse.Namespace):
         rollback_error_pct="rollback_error_pct",
         rollback_p99_x="rollback_p99_x",
         min_workers="min_workers", max_workers="max_workers",
+        join="join", host_id="host_id", lease_ttl_s="lease_ttl",
     )
     ab = getattr(args, "ab_lane", None)
     if ab is not None:
@@ -988,6 +989,44 @@ def cmd_serve(args: argparse.Namespace) -> int:
         cfg.serve.event_log, cfg.serve.event_log_max_mb,
         worker_id=args.worker_id,
     )
+    if getattr(args, "federation", False) and args.worker_id is None:
+        # federation front end: lease/epoch registry + cross-host
+        # router (docs/SERVING.md "Multi-host federation"). Loads no
+        # model, claims no device — host agents bring the fleets.
+        from roko_tpu.serve.federation import run_federation_front
+
+        return run_federation_front(cfg, announce=args.announce)
+    if args.model is None:
+        print(
+            "serve: MODEL is required (only --federation runs "
+            "model-less)", file=sys.stderr,
+        )
+        return 2
+    if (
+        (getattr(args, "host_agent", False) or cfg.fleet.join)
+        and args.worker_id is None
+    ):
+        # host agent: a full supervisor that additionally joins a
+        # federation front and speaks the lease/epoch protocol
+        from roko_tpu.serve.federation import run_host_agent
+
+        if not cfg.fleet.join:
+            print(
+                "serve: --host-agent needs the front end as "
+                "--join HOST:PORT", file=sys.stderr,
+            )
+            return 2
+        if cfg.fleet.workers == 0:
+            print(
+                "serve: a host agent supervises workers — pass "
+                "--workers N (N >= 1 or -1 for auto)", file=sys.stderr,
+            )
+            return 2
+        try:
+            return run_host_agent(args.model, cfg, announce=args.announce)
+        except ValueError as e:
+            print(f"serve: {e}", file=sys.stderr)
+            return 1
     if cfg.fleet.workers != 0 and args.worker_id is None:
         # --workers auto (-1) resolves against the VISIBLE devices and
         # an explicit worker count x mesh size exceeding them refuses —
@@ -1607,7 +1646,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="persistent polishing service: warm model + micro-batched "
         "HTTP /polish (+ /healthz, /metrics)",
     )
-    p.add_argument("model", help="checkpoint dir, saved params, or torch .pth")
+    p.add_argument(
+        "model", nargs="?", default=None,
+        help="checkpoint dir, saved params, or torch .pth (required "
+        "except under --federation, which loads no model)",
+    )
     p.add_argument("--host", default=None, help="bind address (default 127.0.0.1)")
     p.add_argument("--port", type=int, default=None, help="bind port (default 8000; 0 = ephemeral)")
     p.add_argument(
@@ -1728,6 +1771,36 @@ def build_parser() -> argparse.ArgumentParser:
         "workers running the named registered version; per-model "
         "latency histograms render side by side in /metrics "
         "(requests may pin model= explicitly either way)",
+    )
+    p.add_argument(
+        "--federation", action="store_true",
+        help="run the multi-host federation FRONT END instead of a "
+        "fleet: a lease/epoch worker registry + partition-tolerant "
+        "router over host agents that --join it (no model loaded; "
+        "docs/SERVING.md 'Multi-host federation')",
+    )
+    p.add_argument(
+        "--host-agent", action="store_true",
+        help="run this fleet as a federation HOST AGENT: a full "
+        "supervisor that also registers with the front end named by "
+        "--join and keeps its lease alive (implied by --join)",
+    )
+    p.add_argument(
+        "--join", default=None, metavar="HOST:PORT",
+        help="federation front end a host agent registers with; the "
+        "registration is a TTL lease and re-registration bumps this "
+        "host's fencing epoch",
+    )
+    p.add_argument(
+        "--host-id", default=None,
+        help="stable host identity at the federation registry "
+        "(default host-<pid>; set it so a restarted agent bumps the "
+        "SAME host's epoch)",
+    )
+    p.add_argument(
+        "--lease-ttl", type=float, default=None,
+        help="federation lease TTL seconds (default 10; the agent "
+        "renews every ttl/3, expiry drops the host from rotation)",
     )
     # fleet-internal plumbing (the supervisor passes these to its
     # children; automation may use --announce to learn a port-0 bind)

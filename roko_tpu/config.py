@@ -580,6 +580,25 @@ class FleetConfig:
     #: per-model latency histograms side by side in /metrics
     ab_version: Optional[str] = None
     ab_fraction: float = 0.0
+    #: federation (docs/SERVING.md "Multi-host federation"): this
+    #: host's stable identity at the front end's registry. Empty =
+    #: derived from the agent pid (fine for loopback tests, set it for
+    #: real deployments so re-registration after a crash bumps the
+    #: SAME host's epoch instead of minting a new host)
+    host_id: Optional[str] = None
+    #: federation front end to join as ``HOST:PORT`` (set by
+    #: ``--join``); non-empty turns ``roko-tpu serve`` into a host
+    #: agent
+    join: Optional[str] = None
+    #: registration lease TTL in seconds: the agent renews every
+    #: ttl/3; a lease that expires (partitioned or dead agent) leaves
+    #: rotation until the agent re-registers — which bumps the epoch
+    #: and fences the old one
+    lease_ttl_s: float = 10.0
+    #: per-host circuit breaker: consecutive connection failures that
+    #: open it, and seconds until a half-open probe
+    fed_breaker_failures: int = 3
+    fed_breaker_reset_s: float = 10.0
 
     def __post_init__(self) -> None:
         if self.min_workers < 0 or self.max_workers < 0:
@@ -610,6 +629,15 @@ class FleetConfig:
             raise ValueError(
                 f"autoscale_ema_beta must lie in [0, 1); got "
                 f"{self.autoscale_ema_beta}"
+            )
+        if self.lease_ttl_s <= 0:
+            raise ValueError(
+                f"lease_ttl_s must be > 0; got {self.lease_ttl_s}"
+            )
+        if self.fed_breaker_failures < 1:
+            raise ValueError(
+                "fed_breaker_failures must be >= 1; got "
+                f"{self.fed_breaker_failures}"
             )
 
 

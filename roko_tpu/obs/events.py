@@ -38,7 +38,7 @@ Log = Callable[[str], None]
 #: Every one-line event format in the codebase routes through here.
 SUBSYSTEMS = (
     "guard", "watchdog", "failover", "rollout", "fleet", "serve", "trace",
-    "job", "store",
+    "job", "store", "federation",
 )
 
 

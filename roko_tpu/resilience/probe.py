@@ -6,10 +6,17 @@ a process whose backend is already live. This module answers the prior
 question — *is the backend safe to initialize at all?* — by paying the
 init + first-compile cost in a child process. The canary matters: r5
 observed ``jax.devices()`` answering while the first XLA compile blocks
-forever; a devices-only probe waves callers into that tar pit. The
-child is never killed on timeout, only abandoned: killing a TPU client
-mid-claim/compile wedges the loopback relay for the rest of the session
-(observed rounds 2 and 3).
+forever; a devices-only probe waves callers into that tar pit.
+
+Kill policy: on timeout the probe child first gets a grace window
+(``ROKO_BENCH_PROBE_KILL_GRACE_S``, default 20 s) to finish on its
+own — killing a TPU client mid-claim/compile can wedge the loopback
+relay (observed rounds 2 and 3), so an imminent finisher is always
+preferred. A child still stuck after the grace is SIGKILLed and
+reaped: the alternative, leaving a wedged child holding the device
+claim, made the SUBSEQUENT bench child hang for its whole budget too
+("backend probe still hung after 300s" appearing twice per run in the
+BENCH_r0x artifacts). One bounded kill beats two unbounded hangs.
 
 Users: ``roko_tpu/benchmark.py`` (probe-then-measure orchestration) and
 ``tools/chip_probe.py`` (the one-line CHIP_OK/CHIP_DOWN health check) —
@@ -148,6 +155,43 @@ def _wait_stages(proc, log_path: str, timeout_s: float):
         time.sleep(0.5)
 
 
+#: stderr/stdout tail of the most recent probe child, kept for callers
+#: that want the tail as a STRUCTURED field (benchmark.py puts it in
+#: the ``backend_probe`` obs event) without widening the 3-tuple
+#: return that ``tools/chip_probe.py`` unpacks.
+_LAST_TAIL = ""
+
+
+def last_probe_tail() -> str:
+    return _LAST_TAIL
+
+
+def _kill_after_grace(proc, log) -> bool:
+    """The hard backstop for a wedged probe child: wait one more grace
+    window (``ROKO_BENCH_PROBE_KILL_GRACE_S``, default 20 s; 0 = never
+    kill, the historical behavior), then SIGKILL and reap. Returns True
+    when the child was killed. A killed probe can never eat the wall
+    budget twice in one run — the device claim dies with the child
+    before the bench child spawns."""
+    try:
+        grace = float(
+            os.environ.get("ROKO_BENCH_PROBE_KILL_GRACE_S", "20")
+        )
+    except ValueError:
+        grace = 20.0
+    if grace > 0 and wait_no_kill(proc, grace) is not None:
+        return False  # finished on its own inside the grace
+    if grace <= 0 or proc.poll() is not None:
+        return False
+    try:
+        proc.kill()
+        proc.wait(timeout=10.0)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log(f"[bench] probe child SIGKILL failed: {e!r}")
+        return False
+    return True
+
+
 def probe_backend(timeout_s: float, log) -> Tuple[bool, str, Optional[str]]:
     """Can a fresh process initialize the JAX backend AND compile?
 
@@ -156,11 +200,13 @@ def probe_backend(timeout_s: float, log) -> Tuple[bool, str, Optional[str]]:
     backend_init -> canary_compile); a stage that stalls past its budget
     abandons the probe EARLY — callers fall back to CPU in seconds, not
     minutes — and emits a structured ``watchdog`` obs event naming the
-    stuck stage. The child is still never killed (killing a TPU client
-    mid-claim wedges the relay). Returns ``(ok, reason, platform)`` —
-    ``platform`` is the backend the probe actually saw (``"tpu"``,
-    ``"cpu"``, ...) or None when the probe failed before reporting
-    one."""
+    stuck stage. A child still stuck after a further grace window is
+    SIGKILLed and reaped (see module docstring — a wedged probe must
+    not hold the device claim into the bench child's budget). Returns
+    ``(ok, reason, platform)`` — ``platform`` is the backend the probe
+    actually saw (``"tpu"``, ``"cpu"``, ...) or None when the probe
+    failed before reporting one."""
+    global _LAST_TAIL
     from roko_tpu.obs import events as obs_events
 
     with tempfile.NamedTemporaryFile(
@@ -171,7 +217,14 @@ def probe_backend(timeout_s: float, log) -> Tuple[bool, str, Optional[str]]:
             stdout=logf, stderr=subprocess.STDOUT,
         )
         rc, stuck_stage, waited = _wait_stages(proc, logf.name, timeout_s)
+        killed = False
+        if rc is None:
+            killed = _kill_after_grace(proc, log)
+            rc = proc.poll()
+            if killed:
+                rc = None  # a kill rc is not a verdict on the backend
         out = tail_file(logf.name)
+    _LAST_TAIL = out[-2000:]
     if rc is not None:
         try:
             os.unlink(logf.name)
@@ -181,12 +234,16 @@ def probe_backend(timeout_s: float, log) -> Tuple[bool, str, Optional[str]]:
         obs_events.emit(
             "watchdog", "probe_stuck", log=log,
             stage=stuck_stage, waited_s=round(waited, 1),
-            budget_s=timeout_s,
+            budget_s=timeout_s, killed=killed,
+        )
+        fate = (
+            "probe child SIGKILLed after grace"
+            if killed else "probe abandoned, not killed"
         )
         return False, (
             f"backend probe still hung after {waited:.0f}s "
-            f"(stuck in stage {stuck_stage!r}; relay wedged?); probe "
-            f"abandoned, not killed. tail: {out[-300:]}"
+            f"(stuck in stage {stuck_stage!r}; relay wedged?); "
+            f"{fate}. tail: {out[-300:]}"
         ), None
     if rc != 0 or "PROBE_OK" not in out:
         return False, f"backend probe rc={rc}: {out[-400:]}", None

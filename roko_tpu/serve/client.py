@@ -69,16 +69,38 @@ class ServiceUnavailable(ServerBusy):
     degraded, or draining). Typed — not a bare HTTPError — so
     fleet-level load shedding is debuggable from the client side:
     ``attempts`` says how hard the client pushed and ``retry_after_s``
-    what the server last asked for."""
+    what the server last asked for. When the client's total-deadline
+    budget (not the attempt count) ended the retries, ``deadline_s``
+    carries it and the message names it."""
 
-    def __init__(self, retry_after_s: float, attempts: int):
-        RuntimeError.__init__(
-            self,
-            f"service unavailable: all {attempts} attempt(s) got 503; "
-            f"last Retry-After {retry_after_s:.1f}s",
-        )
+    def __init__(
+        self,
+        retry_after_s: float,
+        attempts: int,
+        deadline_s: Optional[float] = None,
+    ):
+        if deadline_s is not None:
+            msg = (
+                f"service unavailable: {attempts} attempt(s) got 503 "
+                f"and the next Retry-After wait would overshoot the "
+                f"client deadline budget deadline_s={deadline_s:.1f}s; "
+                f"last Retry-After {retry_after_s:.1f}s"
+            )
+        else:
+            msg = (
+                f"service unavailable: all {attempts} attempt(s) got "
+                f"503; last Retry-After {retry_after_s:.1f}s"
+            )
+        RuntimeError.__init__(self, msg)
         self.retry_after_s = retry_after_s
         self.attempts = attempts
+        self.deadline_s = deadline_s
+
+
+class _DeadlineExceeded(Exception):
+    """Internal: the deadline-aware sleep refused to start a wait that
+    would overshoot ``deadline_s`` (converted to
+    :class:`ServiceUnavailable` at the retry-loop boundary)."""
 
 
 def parse_503_body(body) -> "tuple[str, float]":
@@ -112,9 +134,21 @@ class PolishClient:
         base_delay_s=0.5, max_delay_s=30.0, retryable=(ServerBusy,)
     )
 
-    def __init__(self, base_url: str, timeout: float = 120.0):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 120.0,
+        deadline_s: Optional[float] = None,
+    ):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        #: client-side TOTAL wall-clock budget across a whole retry
+        #: loop: a fleet shedding load with large Retry-After hints can
+        #: otherwise stretch `retries` waits far past what the caller
+        #: can afford. A retry wait that would overshoot the budget is
+        #: refused up front with :class:`ServiceUnavailable` naming the
+        #: budget. None = unbounded (the historical behavior).
+        self.deadline_s = deadline_s
         self._sleep = time.sleep  # injection point for tests
 
     # -- transport ----------------------------------------------------------
@@ -187,6 +221,7 @@ class PolishClient:
         self, payload: Dict[str, Any], retries: int,
         request_id: Optional[str] = None,
         extra_headers: Optional[Dict[str, str]] = None,
+        deadline_s: Optional[float] = None,
     ) -> Dict[str, Any]:
         """POST /polish, sleeping through up to ``retries``
         :class:`ServerBusy` replies (503: queue full, breaker open, or
@@ -195,12 +230,42 @@ class PolishClient:
         backpressure response unless asked to (``retries=0``).
         Exhausting the budget raises the typed
         :class:`ServiceUnavailable` (a ServerBusy subclass) carrying
-        the attempt count."""
+        the attempt count. ``deadline_s`` (or the constructor's)
+        additionally bounds the TOTAL wall clock: a retry wait that
+        would overshoot it raises ServiceUnavailable naming the budget
+        instead of sleeping into it."""
         import dataclasses
 
         policy = dataclasses.replace(
             self.retry_policy, max_attempts=retries + 1
         )
+        deadline_s = self.deadline_s if deadline_s is None else deadline_s
+        t0 = time.monotonic()
+        attempts = [0]
+        last_hint = [1.0]
+
+        def probe():
+            attempts[0] += 1
+            return (
+                self._request("/polish", payload, headers)
+                if headers
+                else self._request("/polish", payload)
+            )
+
+        def hint(e):
+            v = getattr(e, "retry_after_s", None)
+            if v is not None:
+                last_hint[0] = v
+            return v
+
+        def budget_sleep(delay: float) -> None:
+            if (
+                deadline_s is not None
+                and time.monotonic() - t0 + delay > deadline_s
+            ):
+                raise _DeadlineExceeded()
+            self._sleep(delay)
+
         # the 2-arg call stays the default so _request stand-ins (tests)
         # keep working; headers ride only when something is pinned
         headers = dict(extra_headers or {})
@@ -210,18 +275,18 @@ class PolishClient:
         try:
             return json.loads(
                 policy.call(
-                    lambda: (
-                        self._request("/polish", payload, headers)
-                        if headers
-                        else self._request("/polish", payload)
-                    ),
-                    retry_after=lambda e: getattr(e, "retry_after_s", None),
-                    sleep=self._sleep,
+                    probe,
+                    retry_after=hint,
+                    sleep=budget_sleep,
                     # a draining fleet asks callers to PARK, not retry:
                     # propagate the typed signal with the budget intact
                     giveup=lambda e: isinstance(e, FleetDraining),
                 )
             )
+        except _DeadlineExceeded:
+            raise ServiceUnavailable(
+                last_hint[0], attempts[0], deadline_s=deadline_s
+            ) from None
         except (ServiceUnavailable, FleetDraining):
             raise
         except ServerBusy as e:
@@ -237,6 +302,7 @@ class PolishClient:
         request_id: Optional[str] = None,
         tenant: Optional[str] = None,
         model: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> Dict[str, Any]:
         """Polish one contig from pre-extracted windows. ``retries``
         bounds how many :class:`ServerBusy` replies are slept through
@@ -250,7 +316,12 @@ class PolishClient:
         PINS a registered model version (``X-Roko-Model``): the fleet
         verifies it against the registry and routes to workers running
         it, refusing loudly (RegistryMismatch, HTTP 400) rather than
-        silently serving the incumbent."""
+        silently serving the incumbent.
+
+        ``deadline_s`` caps the TOTAL wall clock the retry loop may
+        spend (overrides the constructor's): large fleet Retry-After
+        hints are honoured only while they fit the budget, past it
+        :class:`ServiceUnavailable` names the budget."""
         examples = np.asarray(examples)
         payload = {
             "contig": contig,
@@ -267,7 +338,8 @@ class PolishClient:
             payload["model"] = model
             headers["X-Roko-Model"] = model
         return self._post_with_retries(
-            payload, retries, request_id, headers or None
+            payload, retries, request_id, headers or None,
+            deadline_s=deadline_s,
         )
 
     def polish_bam(

@@ -1051,6 +1051,10 @@ class Fleet:
             "workers": states,
             "workers_up": up,
             "version": self.active_version,
+            # host-level load signal: the federation front's
+            # HostAutoscaler sizes each host from this
+            # (serve/federation.py)
+            "backlog_windows": self.backlog_windows(),
         }
 
     def render_metrics(self) -> str:

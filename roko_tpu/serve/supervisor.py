@@ -268,14 +268,22 @@ def make_front_server(
     fleet: Fleet,
     host: Optional[str] = None,
     port: Optional[int] = None,
+    *,
+    handler_base: Optional[type] = None,
 ) -> ThreadingHTTPServer:
     """Bind the supervisor front end (port 0 = ephemeral) and return
     the server; the caller runs ``serve_forever``. The fleet rides on
     the server object (``.fleet``) and the lifecycle state matches the
     worker server's, so :func:`roko_tpu.serve.server.drain` works on
-    it unchanged."""
+    it unchanged. ``handler_base`` swaps in a ``_FrontHandler``
+    subclass — the federation host agent layers epoch fencing over the
+    same surface this way (``serve/federation.py``)."""
     serve_cfg = fleet.cfg.serve
-    handler = type("RokoFleetHandler", (_FrontHandler,), {"fleet": fleet})
+    handler = type(
+        "RokoFleetHandler",
+        (handler_base or _FrontHandler,),
+        {"fleet": fleet},
+    )
     server = ThreadingHTTPServer(
         (serve_cfg.host if host is None else host,
          serve_cfg.port if port is None else port),
@@ -695,35 +703,26 @@ def rolling_drain(
     fleet.stop(rolling=True)
 
 
-def run_supervisor(
+def boot_fleet(
     model_path: str,
     cfg: RokoConfig,
     *,
-    announce: Optional[str] = None,
     log=print,
-) -> int:
-    """The ``roko-tpu serve --workers N`` entry point: spawn the fleet,
-    bind the front end, serve until SIGTERM/Ctrl-C. ``announce`` (used
-    by tests/automation) writes ``{"pid", "port"}`` once the front-end
-    socket is bound — the same contract workers honour.
-
-    Before anything spawns, the rollout journal in the runtime dir is
-    consulted: a supervisor killed mid-rollout restarts onto ONE
-    version — finalized forward when every worker had already rolled,
-    reverted to the journaled incumbent otherwise — loudly, never a
-    silently mixed fleet (``serve/rollout.py``)."""
-    # idempotent for CLI callers (cmd_serve already resolved); the real
-    # guard for programmatic users: --workers auto resolves against the
-    # visible devices and an oversubscribing worker x mesh combination
-    # refuses before anything spawns — all without initialising jax
-    fc = resolve_fleet_topology(cfg.fleet)
-    if fc is not cfg.fleet:
-        cfg = dataclasses.replace(cfg, fleet=fc)
+) -> Tuple[Fleet, RolloutJournal, Optional[Dict[str, Any]], str, str,
+           RokoConfig]:
+    """Everything between "a config" and "a Fleet ready to start()":
+    journal-driven rollout recovery, landed-version re-pinning, the
+    boot launch spec, and the A/B lane. Shared by
+    :func:`run_supervisor` and the federation host agent
+    (``serve/federation.py``) so the two entry points cannot drift on
+    what a host boots. Returns ``(fleet, journal, recovery,
+    boot_version, boot_model, boot_cfg)``."""
     fleet = Fleet(
         cfg,
         worker_command=(lambda *_: []),  # placeholder; boot spec below
         log=log,
     )
+    fc = cfg.fleet
     os.makedirs(fleet.runtime_dir, exist_ok=True)
     journal = RolloutJournal(
         os.path.join(fleet.runtime_dir, RolloutJournal.FILENAME)
@@ -797,6 +796,36 @@ def run_supervisor(
             f"roko fleet: A/B lane {fc.ab_version!r} on {n_ab} "
             f"worker(s), {fc.ab_fraction:.0%} of unpinned traffic"
         )
+    return fleet, journal, recovery, boot_version, boot_model, boot_cfg
+
+
+def run_supervisor(
+    model_path: str,
+    cfg: RokoConfig,
+    *,
+    announce: Optional[str] = None,
+    log=print,
+) -> int:
+    """The ``roko-tpu serve --workers N`` entry point: spawn the fleet,
+    bind the front end, serve until SIGTERM/Ctrl-C. ``announce`` (used
+    by tests/automation) writes ``{"pid", "port"}`` once the front-end
+    socket is bound — the same contract workers honour.
+
+    Before anything spawns, the rollout journal in the runtime dir is
+    consulted: a supervisor killed mid-rollout restarts onto ONE
+    version — finalized forward when every worker had already rolled,
+    reverted to the journaled incumbent otherwise — loudly, never a
+    silently mixed fleet (``serve/rollout.py``)."""
+    # idempotent for CLI callers (cmd_serve already resolved); the real
+    # guard for programmatic users: --workers auto resolves against the
+    # visible devices and an oversubscribing worker x mesh combination
+    # refuses before anything spawns — all without initialising jax
+    fc = resolve_fleet_topology(cfg.fleet)
+    if fc is not cfg.fleet:
+        cfg = dataclasses.replace(cfg, fleet=fc)
+    fleet, journal, recovery, boot_version, boot_model, boot_cfg = (
+        boot_fleet(model_path, cfg, log=log)
+    )
 
     server = make_front_server(fleet)
     if fc.ab_version and fc.ab_fraction > 0:

@@ -1,0 +1,1130 @@
+"""Multi-host federation tests (roko_tpu/serve/federation.py +
+transport.py, docs/SERVING.md "Multi-host federation").
+
+The lease/epoch edge matrix is pinned row by row against fake clocks
+and scripted transports — expiry mid-relay, duplicate registration
+from a restarted agent, fenced-zombie reply refusal, partition-heal
+re-registration — plus the FaultyTransport endpoints (rate 0 =
+identity, drop:1 = total partition). The fast end-to-end drives a REAL
+federation front + two host agents supervising stub-worker fleets on
+loopback; the ``slow`` chaos gate (scripted faults + agent SIGKILL
+against real model workers) lives beside it."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from roko_tpu.config import FleetConfig, RokoConfig, ServeConfig
+from roko_tpu.serve.client import (
+    PolishClient,
+    ServerBusy,
+    ServiceUnavailable,
+)
+from roko_tpu.serve.federation import (
+    FED_EPOCH_HEADER,
+    FED_HOST_HEADER,
+    FederationFront,
+    FederationRollout,
+    HostAgent,
+    HostAutoscaler,
+    HostRegistry,
+    make_agent_handler,
+    make_federation_server,
+)
+from roko_tpu.serve.fleet import Fleet
+from roko_tpu.serve.supervisor import make_front_server
+from roko_tpu.serve.transport import (
+    FaultyTransport,
+    HttpTransport,
+    parse_fed_faults,
+    transport_from_env,
+)
+from tests.test_fleet import (
+    fast_fleet_cfg,
+    get_json,
+    make_fleet,
+    post,
+    stop_front,
+    stub_command,
+    wait_until,
+)
+
+
+def noop(_msg):
+    pass
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class ScriptedTransport:
+    """peer -> fn(method, path, headers, body) -> (code, hdrs, bytes);
+    the fn may raise. Every wire call is recorded for ordering and
+    header assertions (the cross-host request_id contract)."""
+
+    def __init__(self, handlers):
+        self.handlers = handlers
+        self.calls = []
+
+    def __call__(self, method, host, port, path, headers=None,
+                 body=None, timeout=10.0, peer=""):
+        self.calls.append((peer, method, path, dict(headers or {})))
+        return self.handlers[peer](
+            method, path, dict(headers or {}), body
+        )
+
+
+def fed_config(**fleet_kw):
+    base = dict(workers=1, lease_ttl_s=10.0, failover_attempts=3)
+    base.update(fleet_kw)
+    return RokoConfig(
+        serve=ServeConfig(max_queue=8, retry_after_s=0.2),
+        fleet=FleetConfig(**base),
+    )
+
+
+def make_scripted_front(handlers, clock=None, **fleet_kw):
+    t = ScriptedTransport(handlers)
+    front = FederationFront(
+        fed_config(**fleet_kw), transport=t,
+        clock=clock or time.monotonic, log=noop,
+    )
+    return front, t
+
+
+def echo_ok(front, host_id, payload=b'{"polished": "ok"}'):
+    """A well-behaved agent: 200 + the CURRENT registry epoch echoed."""
+
+    def h(method, path, headers, body):
+        return 200, {
+            FED_EPOCH_HEADER: str(front.registry.get(host_id).epoch)
+        }, payload
+
+    return h
+
+
+# -- transport: fault spec + injection ----------------------------------------
+
+
+def test_parse_fed_faults_valid_spec():
+    rates, partitions = parse_fed_faults(
+        "drop:0.05, delay:0.1,duplicate:0.02,partition:front-h1,"
+        "partition:h1-h2"
+    )
+    assert rates == {"drop": 0.05, "delay": 0.1, "duplicate": 0.02}
+    assert partitions == {
+        frozenset(("front", "h1")), frozenset(("h1", "h2")),
+    }
+    assert parse_fed_faults("") == ({}, set())
+
+
+def test_parse_fed_faults_refuses_loudly():
+    with pytest.raises(ValueError, match="valid: drop, delay"):
+        parse_fed_faults("chaos:0.5")
+    with pytest.raises(ValueError, match="not a number"):
+        parse_fed_faults("drop:lots")
+    with pytest.raises(ValueError, match="out of range"):
+        parse_fed_faults("drop:1.5")
+    with pytest.raises(ValueError, match="two distinct endpoints"):
+        parse_fed_faults("partition:front")
+    with pytest.raises(ValueError, match="two distinct endpoints"):
+        parse_fed_faults("partition:a-a")
+
+
+def recording_inner(replies=None):
+    calls = []
+    n = [0]
+
+    def inner(method, host, port, path, headers=None, body=None,
+              timeout=10.0, peer=""):
+        calls.append((method, path, peer))
+        n[0] += 1
+        return 200, {}, (b"reply-%d" % n[0] if replies is None
+                         else replies)
+
+    return inner, calls
+
+
+def test_faulty_transport_rate_zero_is_identity():
+    """Rate 0 on every kind injects NOTHING — the chaos config's safe
+    endpoint."""
+    inner, calls = recording_inner(b"ok")
+    t = FaultyTransport(
+        inner, {"drop": 0.0, "delay": 0.0, "duplicate": 0.0}, name="a"
+    )
+    for _ in range(20):
+        assert t("POST", "h", 1, "/polish", peer="b") == (200, {}, b"ok")
+    assert len(calls) == 20
+    assert all(v == 0 for v in t.injected.values())
+
+
+def test_faulty_transport_drop_rate_one_is_total_partition():
+    """drop:1 is the other endpoint: nothing ever reaches the wire."""
+    inner, calls = recording_inner(b"ok")
+    t = FaultyTransport(inner, {"drop": 1.0}, name="a")
+    for _ in range(10):
+        with pytest.raises(ConnectionError, match="injected drop"):
+            t("POST", "h", 1, "/polish", peer="b")
+    assert calls == []
+    assert t.injected["drop"] == 10
+
+
+def test_faulty_transport_duplicate_sends_twice():
+    inner, calls = recording_inner()
+    t = FaultyTransport(inner, {"duplicate": 1.0}, name="a")
+    code, _, body = t("POST", "h", 1, "/polish", peer="b")
+    # both sends hit the wire; the SECOND reply is returned (the
+    # duplicate is the one a fencing/idempotency bug would serve)
+    assert len(calls) == 2
+    assert body == b"reply-2"
+    assert t.injected["duplicate"] == 1
+
+
+def test_faulty_transport_duplicate_falls_back_to_first_reply():
+    n = [0]
+
+    def inner(method, host, port, path, headers=None, body=None,
+              timeout=10.0, peer=""):
+        n[0] += 1
+        if n[0] == 2:
+            raise ConnectionError("second send lost")
+        return 200, {}, b"first"
+
+    t = FaultyTransport(inner, {"duplicate": 1.0}, name="a")
+    assert t("POST", "h", 1, "/p", peer="b") == (200, {}, b"first")
+
+
+def test_faulty_transport_named_partition_and_heal():
+    inner, calls = recording_inner(b"ok")
+    t = FaultyTransport(inner, name="front")
+    t.partition("front", "h1")
+    with pytest.raises(ConnectionError, match="injected partition"):
+        t("GET", "h", 1, "/healthz", peer="h1")
+    # the partition is a named PAIR: other peers are unaffected
+    assert t("GET", "h", 1, "/healthz", peer="h2")[0] == 200
+    t.heal("front", "h1")
+    assert t("GET", "h", 1, "/healthz", peer="h1")[0] == 200
+    assert t.injected["partition"] == 1
+
+
+def test_faulty_transport_refuses_bad_rates():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultyTransport(lambda *a, **k: None, {"chaos": 0.5})
+    with pytest.raises(ValueError, match="out of range"):
+        FaultyTransport(lambda *a, **k: None, {"drop": 2.0})
+
+
+def test_transport_from_env():
+    assert isinstance(transport_from_env("x", env={}), HttpTransport)
+    t = transport_from_env("front", env={
+        "ROKO_FED_FAULTS": "drop:0.25,partition:front-h1",
+        "ROKO_FED_DELAY_S": "0.01",
+        "ROKO_FED_FAULTS_SEED": "7",
+    })
+    assert isinstance(t, FaultyTransport)
+    assert t.rates == {"drop": 0.25}
+    assert t.name == "front"
+    assert t.delay_s == 0.01
+    with pytest.raises(ValueError, match="valid: drop"):
+        transport_from_env("x", env={"ROKO_FED_FAULTS": "nope:1"})
+
+
+# -- lease/epoch registry -----------------------------------------------------
+
+
+def test_lease_register_renew_expire_reregister():
+    clock = FakeClock()
+    reg = HostRegistry(ttl_s=10.0, clock=clock, log=noop)
+    grant = reg.register("h1", "127.0.0.1", 7001, workers=2)
+    assert set(grant) == {"lease_id", "epoch", "ttl_s"}
+    assert grant["epoch"] == 1
+    # renewal extends; a stale lease_id is refused
+    clock.advance(6.0)
+    assert reg.renew("h1", grant["lease_id"])["epoch"] == 1
+    assert reg.renew("h1", "not-the-lease") is None
+    assert reg.renew("ghost", grant["lease_id"]) is None
+    # expiry: out of rotation, renewal refused, epoch NOT bumped
+    clock.advance(11.0)
+    assert reg.sweep() == ["h1"]
+    assert reg.sweep() == []  # already expired: no double-count
+    assert reg.counter("lease_expiries") == 1
+    assert reg.renew("h1", grant["lease_id"]) is None
+    assert reg.pick() is None
+    assert reg.current_epoch("h1") == 1
+    # re-registration (the healed partition) bumps the epoch and
+    # replaces the lease in place: one entry, never duplicates
+    grant2 = reg.register("h1", "127.0.0.1", 7001, workers=2)
+    assert grant2["epoch"] == 2
+    assert grant2["lease_id"] != grant["lease_id"]
+    assert len(reg.hosts()) == 1
+    assert reg.get("h1").state() == "live"
+    assert reg.counter("registrations") == 2
+
+
+def test_duplicate_registration_from_restarted_agent():
+    """A restarted agent re-registers while the old lease is still
+    LIVE: epoch bumps, a single entry survives, and the old lease_id
+    is dead on arrival."""
+    clock = FakeClock()
+    reg = HostRegistry(ttl_s=10.0, clock=clock, log=noop)
+    g1 = reg.register("h1", "127.0.0.1", 7001)
+    g2 = reg.register("h1", "127.0.0.1", 7009)
+    assert (g1["epoch"], g2["epoch"]) == (1, 2)
+    assert len(reg.hosts()) == 1
+    assert reg.get("h1").port == 7009
+    assert reg.renew("h1", g1["lease_id"]) is None  # zombie's lease
+    assert reg.renew("h1", g2["lease_id"]) is not None
+    # the epoch is monotonic across every restart — a stale process
+    # can never collide back into validity
+    assert reg.register("h1", "127.0.0.1", 7001)["epoch"] == 3
+
+
+def test_pick_round_robin_skips_expired_and_open_breakers():
+    clock = FakeClock()
+    reg = HostRegistry(
+        ttl_s=10.0, breaker_failures=1, clock=clock, log=noop
+    )
+    reg.register("h1", "127.0.0.1", 7001)
+    reg.register("h2", "127.0.0.1", 7002)
+    picked = {reg.pick().host_id for _ in range(4)}
+    assert picked == {"h1", "h2"}
+    reg.get("h1").breaker.record_failure()  # opens at 1 failure
+    assert {reg.pick().host_id for _ in range(4)} == {"h2"}
+    clock.advance(11.0)
+    reg.sweep()
+    assert reg.pick() is None
+
+
+# -- partition-tolerant routing (scripted transports) -------------------------
+
+
+def test_expiry_mid_relay_still_serves_the_reply():
+    """The lease expires while the relay is in flight: expiry alone
+    proves nothing about staleness (the epoch did not change), so the
+    reply IS served — but the host is out of rotation for new picks."""
+    clock = FakeClock()
+    handlers = {}
+    front, t = make_scripted_front(handlers, clock=clock)
+    front.registry.register("h1", "127.0.0.1", 7001)
+
+    def h1(method, path, headers, body):
+        clock.advance(11.0)
+        front.registry.sweep()  # expiry lands mid-relay
+        return 200, {FED_EPOCH_HEADER: headers[FED_EPOCH_HEADER]}, \
+            b'{"polished": "late-but-valid"}'
+
+    handlers["h1"] = h1
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-1")
+    assert code == 200
+    assert reply == b'{"polished": "late-but-valid"}'
+    assert extra[FED_HOST_HEADER] == "h1"
+    assert front.registry.counter("fence_refusals") == 0
+    assert front.registry.counter("lease_expiries") == 1
+    assert front.registry.pick() is None
+
+
+def test_agent_fence_409_never_served():
+    """The agent fenced the relay at the source (its epoch is stale):
+    with no other host the client sees 503 — the fenced reply is never
+    served."""
+    handlers = {}
+    front, t = make_scripted_front(handlers)
+    front.registry.register("h1", "127.0.0.1", 7001)
+    handlers["h1"] = lambda m, p, h, b: (
+        409, {}, b'{"error": "fenced: relay epoch 2 != agent epoch 1",'
+                 b' "fenced": true}',
+    )
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-2")
+    assert code == 503
+    assert b"no federated host available" in reply
+    assert front.registry.counter("fence_refusals") == 1
+    # fencing is not a host FAILURE: the process answered, it is just
+    # the wrong epoch — the breaker stays closed
+    assert front.registry.get("h1").state() == "live"
+
+
+def test_stale_epoch_reply_refused_never_served():
+    """A zombie that IGNORES the fencing header and answers 200 under
+    its old epoch is refused on reply at the front end — the last line
+    of the fence."""
+    handlers = {}
+    front, t = make_scripted_front(handlers)
+    front.registry.register("h1", "127.0.0.1", 7001)
+    front.registry.register("h1", "127.0.0.1", 7001)  # epoch now 2
+    handlers["h1"] = lambda m, p, h, b: (
+        200, {FED_EPOCH_HEADER: "1"}, b'{"polished": "ZOMBIE"}',
+    )
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-3")
+    assert code == 503
+    assert b"ZOMBIE" not in reply
+    assert front.registry.counter("fence_refusals") == 1
+
+
+def test_fence_refusal_fails_over_to_good_host():
+    handlers = {}
+    front, t = make_scripted_front(handlers)
+    # registration order pins round-robin: the FIRST pick is the
+    # second-registered host (offset starts at 1)
+    front.registry.register("good", "127.0.0.1", 7002)
+    front.registry.register("bad", "127.0.0.1", 7001)
+    handlers["bad"] = lambda m, p, h, b: (
+        409, {}, b'{"error": "fenced", "fenced": true}',
+    )
+    handlers["good"] = echo_ok(front, "good", b'{"polished": "good"}')
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-4")
+    assert (code, reply) == (200, b'{"polished": "good"}')
+    assert extra[FED_HOST_HEADER] == "good"
+    assert front.registry.counter("fence_refusals") == 1
+    # the request_id rode BOTH relays — the fenced one and the
+    # failover — unchanged (the PR 14 contract, one level up)
+    rids = [c[3]["X-Roko-Request-Id"] for c in t.calls
+            if c[2] == "/polish"]
+    assert rids == ["rid-4", "rid-4"]
+    assert [c[0] for c in t.calls if c[2] == "/polish"] == \
+        ["bad", "good"]
+
+
+def test_conn_error_failover_preserves_request_id_and_opens_breaker():
+    handlers = {}
+    front, t = make_scripted_front(handlers, fed_breaker_failures=1)
+    front.registry.register("good", "127.0.0.1", 7002)
+    front.registry.register("dead", "127.0.0.1", 7001)
+
+    def dead(method, path, headers, body):
+        raise ConnectionError("wire cut")
+
+    handlers["dead"] = dead
+    handlers["good"] = echo_ok(front, "good", b'{"polished": "good"}')
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-5")
+    assert (code, extra[FED_HOST_HEADER]) == (200, "good")
+    assert front.registry.counter("failovers") == 1
+    assert front.registry.get("dead").state() == "breaker-open"
+    rids = [c[3]["X-Roko-Request-Id"] for c in t.calls
+            if c[2] == "/polish"]
+    assert rids == ["rid-5", "rid-5"]
+    # degraded mode: serving on the survivors, loudly visible
+    s = front.summary()
+    assert s["status"] == "degraded"
+    assert s["hosts"]["dead"]["state"] == "breaker-open"
+    assert s["hosts"]["good"]["state"] == "live"
+
+
+def test_all_hosts_down_returns_503_with_retry_after():
+    handlers = {}
+    front, t = make_scripted_front(handlers, fed_breaker_failures=1)
+    front.registry.register("h1", "127.0.0.1", 7001)
+
+    def dead(method, path, headers, body):
+        raise ConnectionError("wire cut")
+
+    handlers["h1"] = dead
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-6")
+    assert code == 503
+    body = json.loads(reply)
+    assert "no federated host available" in body["error"]
+    assert body["retry_after_s"] == pytest.approx(0.2)
+    assert extra["Retry-After"] == "1"
+
+
+def test_503_collects_the_largest_retry_after():
+    handlers = {}
+    front, t = make_scripted_front(handlers)
+    front.registry.register("h1", "127.0.0.1", 7001)
+    front.registry.register("h2", "127.0.0.1", 7002)
+    handlers["h1"] = lambda m, p, h, b: (
+        503, {}, b'{"error": "busy", "retry_after_s": 3.0}',
+    )
+    handlers["h2"] = lambda m, p, h, b: (
+        503, {"Retry-After": "7"}, b'{"error": "busy"}',
+    )
+    code, reply, extra = front.post_polish(b"{}", request_id="rid-7")
+    assert code == 503
+    assert json.loads(reply)["retry_after_s"] == 7.0
+    assert extra["Retry-After"] == "7"
+    # a 503 is an ALIVENESS signal: both hosts stay live
+    assert all(l.state() == "live" for l in front.registry.hosts())
+
+
+def test_summary_warming_ok_degraded_unhealthy():
+    clock = FakeClock()
+    front, _ = make_scripted_front({}, clock=clock)
+    assert (front.summary()["status"], front.summary()["code"]) == \
+        ("warming", 503)
+    g1 = front.registry.register("h1", "127.0.0.1", 7001)
+    front.registry.register("h2", "127.0.0.1", 7002)
+    assert front.summary()["status"] == "ok"
+    clock.advance(6.0)
+    front.registry.renew("h1", g1["lease_id"])
+    clock.advance(5.0)
+    front.registry.sweep()  # h2 expires; h1 renewed
+    s = front.summary()
+    assert (s["status"], s["code"]) == ("degraded", 200)
+    assert s["hosts"]["h2"]["state"] == "expired"
+    assert s["federation"]["lease_expiries"] == 1
+    clock.advance(6.0)
+    front.registry.sweep()
+    assert (front.summary()["status"], front.summary()["code"]) == \
+        ("unhealthy", 503)
+
+
+def test_register_and_renew_validation():
+    front, _ = make_scripted_front({})
+    assert front.handle_register({"host_id": "", "port": 7001})[0] == 400
+    assert front.handle_register({"host_id": "h1", "port": 0})[0] == 400
+    assert front.handle_renew({"host_id": "h1"})[0] == 400
+    code, body = front.handle_renew(
+        {"host_id": "h1", "lease_id": "nope"}
+    )
+    assert code == 404 and "re-register" in body["error"]
+    assert front.scale_host("ghost", 2)[0] == 404
+
+
+# -- host-dimension rollout + autoscale ---------------------------------------
+
+
+def agent_rollout_handler(state_body):
+    def h(method, path, headers, body):
+        if method == "POST" and path == "/rollout":
+            return 202, {}, b"{}"
+        if method == "GET" and path == "/rollout":
+            return 200, {}, json.dumps(state_body).encode()
+        raise AssertionError(f"unexpected {method} {path}")
+
+    return h
+
+
+def test_federation_rollout_rolls_hosts_sequentially():
+    handlers = {}
+    front, t = make_scripted_front(
+        handlers, rollout_ready_timeout_s=10.0
+    )
+    front.registry.register("h1", "127.0.0.1", 7001)
+    front.registry.register("h2", "127.0.0.1", 7002)
+    handlers["h1"] = agent_rollout_handler({"state": "done"})
+    handlers["h2"] = agent_rollout_handler({"state": "done"})
+    code, body = front.start_rollout({"name": "v2"})
+    assert code == 202
+    wait_until(
+        lambda: front.rollout.state == "done", timeout=15,
+        msg="federation rollout done",
+    )
+    posts = [c[0] for c in t.calls
+             if c[1] == "POST" and c[2] == "/rollout"]
+    assert posts == ["h1", "h2"]
+    # host 1's gates landed BEFORE host 2 was touched
+    h1_done = max(i for i, c in enumerate(t.calls)
+                  if c[0] == "h1" and c[1] == "GET")
+    h2_post = next(i for i, c in enumerate(t.calls)
+                   if c[0] == "h2" and c[1] == "POST")
+    assert h1_done < h2_post
+    assert front.rollout.hosts["h1"]["state"] == "done"
+
+
+def test_federation_rollout_aborts_wave_on_host_failure():
+    """Host 1's own canary gates rolled it back: the wave stops and
+    host 2 keeps the incumbent — a bad version can never take the
+    whole federation."""
+    handlers = {}
+    front, t = make_scripted_front(
+        handlers, rollout_ready_timeout_s=10.0
+    )
+    front.registry.register("h1", "127.0.0.1", 7001)
+    front.registry.register("h2", "127.0.0.1", 7002)
+    handlers["h1"] = agent_rollout_handler({"state": "rolled_back"})
+    handlers["h2"] = agent_rollout_handler({"state": "done"})
+    code, _ = front.start_rollout({"name": "v2"})
+    assert code == 202
+    wait_until(
+        lambda: front.rollout.state == "failed", timeout=15,
+        msg="federation rollout failed",
+    )
+    assert [c[0] for c in t.calls
+            if c[1] == "POST" and c[2] == "/rollout"] == ["h1"]
+    assert "h2" not in front.rollout.hosts
+
+
+def test_federation_rollout_refusals():
+    front, _ = make_scripted_front({})
+    assert front.start_rollout({})[0] == 400
+    assert front.start_rollout({"name": "v2"})[0] == 503  # no live host
+    front.registry.register("h1", "127.0.0.1", 7001)
+    front.rollout = FederationRollout(front, {"name": "vX"}, log=noop)
+    front.rollout.state = "rolling"
+    code, body = front.start_rollout({"name": "v2"})
+    assert code == 409 and "already in progress" in body["error"]
+
+
+def test_host_autoscaler_scales_each_host_independently():
+    clock = FakeClock()
+    handlers = {}
+    front, t = make_scripted_front(
+        handlers, clock=clock,
+        min_workers=1, max_workers=3,
+        autoscale_up_backlog=10.0, autoscale_down_backlog=2.0,
+        autoscale_idle_s=1.0, autoscale_cooldown_s=0.0,
+        autoscale_ema_beta=0.0,
+    )
+    front.registry.register("hot", "127.0.0.1", 7001)
+    front.registry.register("cold", "127.0.0.1", 7002)
+    scaled = {}
+
+    def agent(hid, backlog):
+        def h(method, path, headers, body):
+            if path == "/healthz":
+                return 200, {}, json.dumps({
+                    "workers": {"0": {}, "1": {}},
+                    "backlog_windows": backlog[0],
+                }).encode()
+            if path == "/scale":
+                scaled[hid] = json.loads(body)["workers"]
+                return 200, {}, b'{"ok": 1}'
+            raise AssertionError(path)
+
+        return h
+
+    hot_backlog, cold_backlog = [100.0], [0.0]
+    handlers["hot"] = agent("hot", hot_backlog)
+    handlers["cold"] = agent("cold", cold_backlog)
+    scaler = HostAutoscaler(front, log=noop, clock=clock)
+    assert scaler.enabled
+    # the saturated host scales up; its idle peer is untouched (the
+    # idle clock has only just started)
+    assert scaler.tick() == {"hot": "up"}
+    assert scaled == {"hot": 3}
+    # a continuous idle stretch scales the cold host down to the floor
+    clock.advance(2.0)
+    hot_backlog[0] = 0.0
+    scaler.tick()  # idle_since starts for both
+    clock.advance(2.0)
+    actions = scaler.tick()
+    assert actions["cold"] == "down"
+    assert scaled["cold"] == 1
+
+
+def test_host_autoscaler_disabled_without_headroom():
+    front, _ = make_scripted_front({}, min_workers=0, max_workers=0)
+    assert not HostAutoscaler(front, log=noop).enabled
+
+
+# -- end-to-end on loopback: real agents, stub-worker fleets ------------------
+
+
+def _start_serving(server):
+    th = threading.Thread(target=server.serve_forever, daemon=True)
+    th.start()
+    return th
+
+
+def test_federation_end_to_end_two_hosts(tmp_path):
+    """A real federation front + two host agents, each supervising a
+    real (stub-worker) Fleet over TCP on loopback: registration,
+    round-robin relays, zombie fencing after an epoch bump, and
+    degraded-mode survival after one host's front dies — with zero
+    client-visible errors throughout."""
+    fed_front = FederationFront(
+        fed_config(lease_ttl_s=2.0, fed_breaker_failures=1,
+                   fed_breaker_reset_s=0.5),
+        log=noop,
+    )
+    fed_server = make_federation_server(
+        fed_front, host="127.0.0.1", port=0
+    )
+    fed_thread = _start_serving(fed_server)
+    fed_port = fed_server.server_address[1]
+    fed_front.start()
+    fleets, agents, servers, threads = [], [], [], []
+    try:
+        for i in range(2):
+            cfg = RokoConfig(
+                serve=ServeConfig(max_queue=8, retry_after_s=0.2),
+                fleet=fast_fleet_cfg(
+                    workers=1, host_id=f"h{i}",
+                    join=f"127.0.0.1:{fed_port}", lease_ttl_s=2.0,
+                ),
+            )
+            fleet = Fleet(
+                cfg, stub_command,
+                runtime_dir=str(tmp_path / f"host{i}"), log=noop,
+            )
+            agent = HostAgent(fleet, cfg, log=noop)
+            server = make_front_server(
+                fleet, port=0, handler_base=make_agent_handler(agent)
+            )
+            threads.append(_start_serving(server))
+            fleet.start()
+            agent.start(server.server_address[1])
+            fleets.append(fleet)
+            agents.append(agent)
+            servers.append(server)
+        wait_until(
+            lambda: len(fed_front.registry.live()) == 2
+            and all(get_json(s.server_address[1], "/healthz")[0] == 200
+                    for s in servers),
+            timeout=30, msg="both hosts registered and ready",
+        )
+        client = PolishClient(f"http://127.0.0.1:{fed_port}", timeout=30)
+        replies = [post(client) for _ in range(4)]
+        assert all(r["polished"].startswith("STUB-") for r in replies)
+        # round-robin spread the load across BOTH hosts' workers
+        assert len({r["polished"] for r in replies}) >= 2
+        assert fed_front.registry.counter("relays") >= 4
+        code, body = get_json(fed_port, "/healthz")
+        assert code == 200 and body["status"] == "ok"
+        assert set(body["hosts"]) == {"h0", "h1"}
+        assert body["federation"]["fence_refusals"] == 0
+        # the third histogram rung + host-labeled re-exports
+        text = fed_front.render_metrics()
+        assert "roko_federation_hosts 2" in text
+        assert "roko_federation_hosts_up 2" in text
+        assert 'roko_fleet_workers{host="h0"}' in text
+        assert 'host="h1"' in text
+        # --- zombie fencing: h0's agent keeps epoch 1 while the
+        # registry (a "restarted" registration) moves to epoch 2 ---
+        agents[0].stop()  # no heal: the zombie never re-registers
+        time.sleep(0.05)
+        fed_front.registry.register(
+            "h0", "127.0.0.1", servers[0].server_address[1], workers=1
+        )
+        for _ in range(2):  # both round-robin slots: one hits h0
+            assert post(client)["polished"].startswith("STUB-")
+        assert fed_front.registry.counter("fence_refusals") >= 1
+        # --- host death: SIGKILL-equivalent (front socket gone);
+        # the survivors keep serving with zero client errors ---
+        stop_front(servers[0], threads[0])
+        for _ in range(3):
+            assert post(client)["polished"].startswith("STUB-")
+        wait_until(
+            lambda: get_json(fed_port, "/healthz")[1]["status"]
+            == "degraded",
+            timeout=15, msg="degraded mode after host death",
+        )
+        code, body = get_json(fed_port, "/healthz")
+        assert body["hosts"]["h0"]["state"] in (
+            "expired", "breaker-open",
+        )
+        assert body["hosts"]["h1"]["state"] == "live"
+    finally:
+        fed_front.stop()
+        for a in agents:
+            a.stop()
+        stop_front(fed_server, fed_thread)
+        for s, th in list(zip(servers, threads))[1:]:
+            stop_front(s, th)
+        for f in fleets:
+            f.stop(rolling=False)
+
+
+def test_agent_handler_echoes_epoch_and_scales(tmp_path):
+    """Every agent reply carries X-Roko-Fed-Epoch (fencing must work
+    on every path), /healthz carries the host identity, and /scale
+    resizes the local fleet through the PR 19 machinery."""
+    fleet = make_fleet(tmp_path, workers=1)
+    cfg = RokoConfig(
+        serve=ServeConfig(max_queue=8, retry_after_s=0.2),
+        fleet=fast_fleet_cfg(
+            workers=1, host_id="solo", join="127.0.0.1:1",
+        ),
+    )
+    agent = HostAgent(fleet, cfg, log=noop)
+    agent.epoch = 5
+    server = make_front_server(
+        fleet, port=0, handler_base=make_agent_handler(agent)
+    )
+    th = _start_serving(server)
+    port = server.server_address[1]
+    try:
+        fleet.start()
+        wait_until(
+            lambda: get_json(port, "/healthz")[0] == 200,
+            timeout=30, msg="solo fleet ready",
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            assert r.headers[FED_EPOCH_HEADER] == "5"
+            body = json.loads(r.read())
+        assert body["host_id"] == "solo"
+        assert body["epoch"] == 5
+        assert "backlog_windows" in body  # the autoscaler's load signal
+        # fenced relay: a NEWER epoch in the relay header means this
+        # process is the zombie — 409, never a worker touch
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/polish", data=b"{}",
+            headers={FED_EPOCH_HEADER: "6"}, method="POST",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            pytest.fail("fenced relay was served")
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+            assert json.loads(e.read())["fenced"] is True
+        # scale the local fleet through the agent
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/scale",
+            data=json.dumps({"workers": 2}).encode(), method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert json.loads(r.read())["workers"] == 2
+        wait_until(
+            lambda: len(fleet.workers) == 2, timeout=15,
+            msg="scale-up through the agent",
+        )
+    finally:
+        stop_front(server, th)
+        fleet.stop(rolling=False)
+
+
+def test_host_agent_requires_join_target(tmp_path):
+    fleet = make_fleet(tmp_path, workers=1)
+    with pytest.raises(ValueError, match="--join"):
+        HostAgent(fleet, fed_config(), log=noop)
+
+
+# -- trace_probe: host-labeled rendering --------------------------------------
+
+
+def test_trace_probe_renders_host_rows_and_federation_counters(capsys):
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_probe",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "trace_probe.py"),
+    )
+    tp = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tp)
+    text = "\n".join([
+        'roko_request_latency_seconds_bucket{le="0.1"} 5',
+        'roko_request_latency_seconds_bucket{le="+Inf"} 5',
+        'roko_request_latency_seconds_bucket{le="0.1",host="h0"} 2',
+        'roko_request_latency_seconds_bucket{le="+Inf",host="h0"} 2',
+        'roko_request_latency_seconds_bucket{le="0.1",host="h1"} 3',
+        'roko_request_latency_seconds_bucket{le="+Inf",host="h1"} 3',
+        "roko_federation_hosts 2",
+        "roko_federation_hosts_up 1",
+        "roko_federation_lease_expiries_total 3",
+        "roko_federation_fence_refusals_total 1",
+    ]) + "\n"
+    tp.print_metrics(text)
+    out = capsys.readouterr().out
+    assert 'roko_request_latency_seconds{host="h0"}' in out
+    assert 'roko_request_latency_seconds{host="h1"}' in out
+    assert ("federation: hosts=2 up=1 lease_expiries=3 "
+            "fence_refusals=1") in out
+
+
+# -- satellite: client-side total-deadline budget -----------------------------
+
+
+def test_client_deadline_budget_names_the_budget():
+    c = PolishClient("http://127.0.0.1:1", deadline_s=5.0)
+    slept = []
+    c._sleep = slept.append
+
+    def busy(*a, **kw):
+        raise ServerBusy(30.0)
+
+    c._request = busy
+    with pytest.raises(ServiceUnavailable) as ei:
+        c._post_with_retries({}, retries=3)
+    # the FIRST 30 s wait would already overshoot the 5 s budget: no
+    # sleep ever happens, and the error names the budget
+    assert slept == []
+    assert ei.value.deadline_s == 5.0
+    assert "deadline_s=5.0" in str(ei.value)
+    assert "1 attempt(s)" in str(ei.value)
+
+
+def test_client_deadline_per_call_overrides_constructor():
+    c = PolishClient("http://127.0.0.1:1")
+    c._sleep = lambda s: None
+
+    def busy(*a, **kw):
+        raise ServerBusy(30.0)
+
+    c._request = busy
+    with pytest.raises(ServiceUnavailable, match="deadline_s=2.0"):
+        c._post_with_retries({}, retries=3, deadline_s=2.0)
+
+
+def test_client_without_deadline_keeps_historical_message():
+    c = PolishClient("http://127.0.0.1:1")
+    slept = []
+    c._sleep = slept.append
+
+    def busy(*a, **kw):
+        raise ServerBusy(0.01)
+
+    c._request = busy
+    with pytest.raises(ServiceUnavailable) as ei:
+        c._post_with_retries({}, retries=2)
+    assert len(slept) == 2
+    assert "all 3 attempt(s)" in str(ei.value)
+    assert "deadline" not in str(ei.value)
+    assert ei.value.deadline_s is None
+
+
+# -- the federation chaos gate (slow lane) ------------------------------------
+
+
+@pytest.mark.slow
+def test_federation_chaos_gate(tmp_path, rng):
+    """The acceptance bar: 2 real host-agent subprocesses (each
+    supervising 2 real workers, spawned through the CLI) behind an
+    in-process federation front whose relay transport injects the
+    default drop/delay/duplicate rates, plus a scripted partition
+    pulse and a SIGKILL of one agent's whole process group mid-load —
+    zero client-visible errors, every reply byte-identical to the
+    single-process inference path, and the killed host rejoins (epoch
+    bumped) and is routed to again."""
+    import os
+    import signal
+
+    import numpy as np
+
+    from roko_tpu.data.hdf5 import DataWriter
+    from roko_tpu.infer import run_inference
+    from roko_tpu.serve.client import _b64
+    from tests.test_fleet import _real_fleet_setup, _serve_windows
+
+    cfg, params, _unused_fleet = _real_fleet_setup(tmp_path, workers=2)
+    ckpt = str(tmp_path / "ckpt")
+    agent_cfg_path = str(tmp_path / "agent-config.json")
+    with open(agent_cfg_path, "w") as f:
+        f.write(cfg.to_json())  # fleet.workers=2 rides in the JSON
+
+    draft = "".join(rng.choice(list("ACGT"), 500))
+    positions, x = _serve_windows(rng, 7)
+    path = tmp_path / "infer.hdf5"
+    with DataWriter(str(path), infer=True) as w:
+        w.write_contigs([("ctg", draft)])
+        w.store("ctg", list(positions), list(x), None)
+    expected = run_inference(
+        str(path), params, cfg, batch_size=8, log=lambda s: None
+    )["ctg"]
+
+    # the front end runs in-process so the chaos is SCRIPTABLE: env
+    # rates on every relay, plus partition()/heal() pulses mid-test
+    faults = FaultyTransport(
+        HttpTransport(),
+        {"drop": 0.1, "delay": 0.2, "duplicate": 0.1},
+        seed=1234, name="front", delay_s=0.02,
+    )
+    front = FederationFront(
+        fed_config(
+            lease_ttl_s=2.0, fed_breaker_failures=2,
+            fed_breaker_reset_s=0.5, failover_attempts=4,
+        ),
+        transport=faults, log=noop,
+    )
+    fed_server = make_federation_server(front, host="127.0.0.1", port=0)
+    fed_thread = _start_serving(fed_server)
+    fed_port = fed_server.server_address[1]
+    front.start()
+
+    def spawn_agent(i, tag=""):
+        announce = str(tmp_path / f"agent{i}{tag}.announce.json")
+        env = dict(os.environ)
+        env["ROKO_FED_FAULTS"] = "drop:0.1,delay:0.2,duplicate:0.1"
+        env["ROKO_FED_DELAY_S"] = "0.02"
+        env["ROKO_FED_FAULTS_SEED"] = str(100 + i)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "roko_tpu", "serve", ckpt,
+             "--config", agent_cfg_path, "--port", "0",
+             "--host-agent", "--join", f"127.0.0.1:{fed_port}",
+             "--host-id", f"h{i}", "--lease-ttl", "2.0",
+             "--announce", announce],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            start_new_session=True, env=env,
+        )
+        return proc, announce
+
+    def killpg(proc):
+        if proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+            except (OSError, ProcessLookupError):
+                proc.kill()
+        try:
+            proc.communicate(timeout=30.0)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def agent_ready(announce):
+        if not os.path.exists(announce):
+            return False
+        with open(announce) as f:
+            port = json.load(f)["port"]
+        try:
+            return get_json(port, "/healthz")[1].get("status") == "ok"
+        except OSError:
+            return False
+
+    payload = {
+        "contig": "ctg", "draft": draft, "n": int(x.shape[0]),
+        "positions": _b64(positions, np.int64),
+        "examples": _b64(x, np.uint8),
+    }
+
+    def raw_post():
+        """POST /polish and read which host served (X-Roko-Host),
+        riding out fault-induced 503s like any retrying client."""
+        for _ in range(30):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fed_port}/polish",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    return r.headers.get(FED_HOST_HEADER), \
+                        json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                e.read()
+                time.sleep(0.3)
+            except OSError:
+                time.sleep(0.3)
+        pytest.fail("no reply through the federation front")
+
+    procs = {}
+    try:
+        for i in range(2):
+            procs[i] = spawn_agent(i)
+        wait_until(
+            lambda: len(front.registry.live()) == 2
+            and all(agent_ready(a) for _, a in procs.values()),
+            timeout=300.0, msg="2 host agents registered and warm",
+        )
+
+        replies, errors = [], []
+
+        def one_client():
+            client = PolishClient(
+                f"http://127.0.0.1:{fed_port}", timeout=120.0
+            )
+            for _ in range(8):
+                try:
+                    replies.append(client.polish(
+                        draft, positions, x, contig="ctg", retries=12,
+                    ))
+                except Exception as e:
+                    errors.append(repr(e))
+
+        clients = [
+            threading.Thread(target=one_client, daemon=True)
+            for _ in range(2)
+        ]
+        for t in clients:
+            t.start()
+        # scripted partition pulse: cut front<->h1, serve on h0 alone,
+        # heal — the client must never notice
+        wait_until(lambda: len(replies) >= 2, timeout=300.0,
+                   msg="first replies before the partition pulse")
+        faults.partition("front", "h1")
+        time.sleep(0.5)
+        faults.heal("front", "h1")
+        # host death mid-load: SIGKILL agent 0's whole process group
+        # (supervisor AND its workers — the machine died)
+        wait_until(lambda: len(replies) >= 6, timeout=300.0,
+                   msg="replies before the SIGKILL")
+        killpg(procs[0][0])
+        for t in clients:
+            t.join(300.0)
+        assert errors == []  # zero client-visible failures
+        assert len(replies) == 16
+        for r in replies:
+            assert r["polished"] == expected  # byte-identical, always
+        assert front.registry.counter("relays") >= 16
+        # the chaos really happened (seeded rates + the pulse)
+        assert sum(faults.injected.values()) > 0
+
+        # the killed host rejoins under a BUMPED epoch and takes
+        # traffic again
+        old_epoch = front.registry.current_epoch("h0")
+        procs[2] = spawn_agent(0, tag="b")
+        wait_until(
+            lambda: (lambda l: l is not None and l.state() == "live"
+                     and l.epoch > old_epoch)(front.registry.get("h0"))
+            and agent_ready(procs[2][1]),
+            timeout=300.0, msg="killed host rejoined",
+        )
+        served_by = set()
+        for _ in range(10):
+            hid, body = raw_post()
+            assert body["polished"] == expected
+            served_by.add(hid)
+            if "h0" in served_by:
+                break
+        assert "h0" in served_by  # routed to again after rejoin
+    finally:
+        for p, _ in procs.values():
+            killpg(p)
+        front.stop()
+        stop_front(fed_server, fed_thread)
+
+
+# -- satellite: probe SIGKILL-after-grace -------------------------------------
+
+
+def test_kill_after_grace_sigkills_wedged_child(monkeypatch):
+    from roko_tpu.resilience import probe
+
+    monkeypatch.setenv("ROKO_BENCH_PROBE_KILL_GRACE_S", "0.1")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"]
+    )
+    try:
+        assert probe._kill_after_grace(proc, noop) is True
+        assert proc.poll() is not None
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_kill_after_grace_zero_never_kills(monkeypatch):
+    """Grace 0 is the historical never-kill behavior, kept reachable."""
+    from roko_tpu.resilience import probe
+
+    monkeypatch.setenv("ROKO_BENCH_PROBE_KILL_GRACE_S", "0")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(120)"]
+    )
+    try:
+        assert probe._kill_after_grace(proc, noop) is False
+        assert proc.poll() is None  # still running: never killed
+    finally:
+        proc.kill()
+        proc.wait()
+
+
+def test_kill_after_grace_spares_a_prompt_finisher(monkeypatch):
+    """A child that finishes inside the grace window is NEVER killed —
+    an imminent finisher beats a kill (its result still counts)."""
+    from roko_tpu.resilience import probe
+
+    monkeypatch.setenv("ROKO_BENCH_PROBE_KILL_GRACE_S", "15")
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    try:
+        assert probe._kill_after_grace(proc, noop) is False
+        assert proc.poll() == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -17,7 +17,11 @@ id and every worker renders in turn.
 MERGEABLE histogram rows (`roko_request_latency_seconds_bucket` and the
 queue-wait / device-time decomposition) — on a supervisor these are the
 bucket-summed fleet rows, so the printed p99 is the fleet p99, not a
-per-worker passthrough.
+per-worker passthrough. Against a federation front end (docs/SERVING.md
+"Multi-host federation") the ladder gains one more rung: the aggregate
+is the cross-host bucket sum, per-host ``host="h"`` quantile rows render
+beside it, and the ``roko_federation_*`` host/lease/fence counters print
+at the bottom.
 
 Stdlib-only, like every tools/ probe.
 """
@@ -199,6 +203,13 @@ def print_metrics(text: str) -> None:
                 (f'model="{m}"', {"model": m})
                 for m in _label_values(rows, "model")
             ]
+            # federation front ends re-export each host's fleet-merged
+            # rows with host="h" appended — one quantile row per host
+            # beside the federation-wide aggregate
+            variants += [
+                (f'host="{h}"', {"host": h})
+                for h in _label_values(rows, "host")
+            ]
         for suffix, want in variants:
             buckets = _hist_rows(rows, want)
             if not buckets:
@@ -218,6 +229,19 @@ def print_metrics(text: str) -> None:
             f"cascade: windows={windows:.0f} "
             f"escalation_fraction={escalated / windows:.3f} "
             f"cache_hit_rate={hits / windows:.3f}"
+        )
+    hosts = _counter_total(text, "roko_federation_hosts")
+    if hosts is not None:
+        up = _counter_total(text, "roko_federation_hosts_up") or 0.0
+        expiries = _counter_total(
+            text, "roko_federation_lease_expiries_total"
+        ) or 0.0
+        fences = _counter_total(
+            text, "roko_federation_fence_refusals_total"
+        ) or 0.0
+        print(
+            f"federation: hosts={hosts:.0f} up={up:.0f} "
+            f"lease_expiries={expiries:.0f} fence_refusals={fences:.0f}"
         )
 
 
